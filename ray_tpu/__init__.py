@@ -1,0 +1,47 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Core: tasks, actors, objects, placement groups over a shared-memory object
+store and a resource-aware scheduler (capability parity with the reference
+Ray core — see SURVEY.md §2).  Libraries: ray_tpu.train / .data / .tune /
+.rllib / .serve, all built TPU-first on jax/pjit/shard_map/Pallas.
+"""
+
+from .core.api import (
+    ActorClass,
+    ActorHandle,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    list_named_actors,
+    nodes,
+    placement_group,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    timeline,
+    wait,
+)
+from .core.context import get_runtime_context
+from .core.object_ref import ObjectRef, ObjectRefGenerator
+from . import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "cancel", "kill", "get_actor", "list_named_actors", "placement_group",
+    "remove_placement_group", "PlacementGroup",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+    "cluster_resources", "available_resources", "nodes", "timeline",
+    "ObjectRef", "ObjectRefGenerator", "ActorClass", "ActorHandle",
+    "exceptions", "get_runtime_context", "__version__",
+]

@@ -1,0 +1,622 @@
+"""Public API: init/shutdown, @remote tasks & actors, get/put/wait, placement
+groups, named actors.
+
+Role-equivalent to the reference's python/ray/_private/worker.py:1227 (init),
+:2567/2693/2758 (get/put/wait), remote_function.py:40 (RemoteFunction),
+actor.py:581 (ActorClass) / :1238 (ActorHandle), util/placement_group.py.
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import inspect
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import cloudpickle
+
+from .. import exceptions
+from . import serialization
+from .client import Client
+from .config import Config, get_config, set_config
+from .context import ctx
+from .head import Head
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+from .object_ref import ObjectRef, ObjectRefGenerator, _TopLevelRef
+from .rpc import ServerThread
+from .scheduler import SchedulingStrategy
+
+_init_lock = threading.RLock()
+
+# ----------------------------------------------------------------- scheduling
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[dict], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        return True  # creation is synchronous in this control plane
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = -1):
+        self.placement_group = placement_group
+        self.bundle_index = placement_group_bundle_index
+
+    def to_wire(self) -> dict:
+        return {
+            "kind": "placement_group",
+            "pg_id": self.placement_group.id.binary(),
+            "bundle_index": self.bundle_index,
+        }
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+    def to_wire(self) -> dict:
+        return {
+            "kind": "node_affinity",
+            "node_id": bytes.fromhex(self.node_id),
+            "soft": self.soft,
+        }
+
+
+def _strategy_wire(strategy) -> Optional[dict]:
+    if strategy is None or strategy == "DEFAULT":
+        return None
+    if strategy == "SPREAD":
+        return {"kind": "spread"}
+    if hasattr(strategy, "to_wire"):
+        return strategy.to_wire()
+    raise ValueError(f"unknown scheduling strategy {strategy!r}")
+
+
+# ------------------------------------------------------------------- init
+
+
+def _detect_resources(num_cpus=None, num_tpus=None, resources=None) -> Dict[str, float]:
+    out: Dict[str, float] = dict(resources or {})
+    out["CPU"] = float(num_cpus if num_cpus is not None else os.cpu_count() or 1)
+    if num_tpus is None:
+        num_tpus = float(os.environ.get("RT_NUM_TPUS", 0))
+    if num_tpus:
+        out["TPU"] = float(num_tpus)
+        # Pod-slice head marker resource, mirroring the reference's
+        # TPU-v4-16-head style resources (python/ray/_private/accelerators/
+        # tpu.py:198) so gang jobs can target a slice's head host.
+        accel = os.environ.get("RT_TPU_ACCELERATOR_TYPE")
+        if accel:
+            out[f"TPU-{accel}-head"] = 1.0
+    out.setdefault("memory", float(2**33))
+    return out
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    num_workers: Optional[int] = None,
+    namespace: str = "default",
+    object_store_memory: Optional[int] = None,
+    system_config: Optional[dict] = None,
+    labels: Optional[Dict[str, str]] = None,
+    ignore_reinit_error: bool = False,
+):
+    """Start (or connect to) a cluster.  With no address, an in-process control
+    plane is started and worker processes are spawned on demand."""
+    with _init_lock:
+        if ctx.initialized:
+            if ignore_reinit_error:
+                return ctx
+            raise RuntimeError("ray_tpu.init() called twice "
+                               "(pass ignore_reinit_error=True to allow)")
+        cfg = Config().apply_env_overrides().apply_overrides(system_config)
+        if object_store_memory:
+            cfg.object_store_memory = object_store_memory
+        set_config(cfg)
+
+        if address is None and os.environ.get("RT_ADDRESS"):
+            address = os.environ["RT_ADDRESS"]
+
+        if address is None:
+            session = uuid.uuid4().hex[:12]
+            head = Head(cfg, session)
+            server_thread = ServerThread(head.server)
+            # Head.start assigns the port inside the server thread's loop.
+            server_thread.loop.call_soon_threadsafe(lambda: None)
+            port = server_thread.start()
+            head.port = port
+            node_resources = _detect_resources(num_cpus, num_tpus, resources)
+            cap = num_workers if num_workers is not None else (
+                cfg.num_workers or int(node_resources["CPU"])
+            )
+            server_thread.run_coro(
+                _add_local_node(head, node_resources, cap, labels)
+            ).result(timeout=10)
+            # Prestart the worker pool so first tasks don't pay process spawn
+            # latency (reference: worker_pool.h prestarts num_cpus workers).
+            prestart = min(cap, int(os.environ.get("RT_PRESTART_WORKERS", cap)))
+            server_thread.run_coro(
+                _prestart_workers(head, prestart)
+            ).result(timeout=10)
+            ctx.head_process = (head, server_thread)
+            address = f"127.0.0.1:{port}"
+            os.environ["RT_ADDRESS"] = address
+
+        ctx.client = Client(address, kind="driver", pid=os.getpid())
+        ctx.mode = "driver"
+        ctx.session = ctx.client.session
+        ctx.namespace = namespace
+        atexit.register(shutdown)
+        return ctx
+
+
+async def _add_local_node(head: Head, resources, cap, labels):
+    head.add_local_node(resources, cap, labels)
+
+
+async def _prestart_workers(head: Head, n: int):
+    for _ in range(n):
+        head._spawn_worker(head.local_node_id)
+
+
+def is_initialized() -> bool:
+    return ctx.initialized
+
+
+def _ensure_init():
+    if not ctx.initialized:
+        init()
+
+
+def shutdown():
+    with _init_lock:
+        if not ctx.initialized:
+            return
+        head_proc = ctx.head_process
+        client = ctx.client
+        try:
+            if head_proc is not None:
+                head, server_thread = head_proc
+                try:
+                    server_thread.run_coro(head.stop()).result(timeout=5)
+                except Exception:
+                    pass
+                server_thread.stop()
+            client.close()
+        finally:
+            os.environ.pop("RT_ADDRESS", None)
+            ctx.reset()
+
+
+# --------------------------------------------------------------- object API
+
+
+def put(value: Any) -> ObjectRef:
+    _ensure_init()
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return ObjectRef(ctx.client.put(value))
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: float = -1.0):
+    _ensure_init()
+    single = isinstance(refs, ObjectRef)
+    batch = [refs] if single else list(refs)
+    for r in batch:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    values = ctx.client.get(batch, timeout=timeout)
+    return values[0] if single else values
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+):
+    _ensure_init()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return ctx.client.wait(
+        list(refs), num_returns, -1.0 if timeout is None else timeout
+    )
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    _ensure_init()
+    ctx.client.call(
+        "cancel_task",
+        {"task_id": ref.task_id().binary(), "force": force},
+    )
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True):
+    _ensure_init()
+    ctx.client.call(
+        "kill_actor",
+        {"actor_id": actor._actor_id.binary(), "no_restart": no_restart},
+    )
+
+
+# ----------------------------------------------------------------- functions
+
+
+def _export(blob: bytes, prefix: str) -> str:
+    """Export a pickled function/class to the cluster function table, dedup by
+    content hash (reference: src/ray/gcs/gcs_server/gcs_function_manager.h)."""
+    key = f"{prefix}:{hashlib.sha1(blob).hexdigest()}"
+    ctx.client.kv_put(key, blob, overwrite=False)
+    return key
+
+
+def _pack_args(args: tuple, kwargs: dict):
+    """Replace top-level ObjectRefs with markers; returns (blob, arg_ids,
+    args_ref).  Large argument payloads go to the object store."""
+    cfg = get_config()
+    arg_ids: List[bytes] = []
+    proc_args = []
+    for a in args:
+        if isinstance(a, ObjectRef):
+            arg_ids.append(a.binary())
+            proc_args.append(_TopLevelRef(a.binary()))
+        else:
+            proc_args.append(a)
+    proc_kwargs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, ObjectRef):
+            arg_ids.append(v.binary())
+            proc_kwargs[k] = _TopLevelRef(v.binary())
+        else:
+            proc_kwargs[k] = v
+    meta, buffers = serialization.serialize((tuple(proc_args), proc_kwargs))
+    size = serialization.packed_size(meta, buffers)
+    if size <= cfg.inline_object_max_bytes:
+        blob = bytearray(size)
+        serialization.pack_into(meta, buffers, memoryview(blob))
+        return bytes(blob), arg_ids, None
+    # Large args ride the object store instead of the RPC channel
+    # (reference: _raylet.pyx submit_task puts large args into plasma).
+    oid = ObjectID.from_random()
+    buf = ctx.client.store().create(oid, size)
+    serialization.pack_into(meta, buffers, buf)
+    ctx.client.call(
+        "put_object",
+        {"object_id": oid.binary(), "size": size,
+         "node_id": ctx.client.node_id.binary()},
+    )
+    return None, arg_ids, oid.binary()
+
+
+_VALID_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
+    "retry_exceptions", "name", "scheduling_strategy", "runtime_env",
+    "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
+    "namespace", "memory", "_metadata",
+}
+
+
+def _resources_from_options(o: dict, default_cpu: float = 1.0) -> Dict[str, float]:
+    res = dict(o.get("resources") or {})
+    res["CPU"] = float(o["num_cpus"]) if o.get("num_cpus") is not None else default_cpu
+    if o.get("num_tpus"):
+        res["TPU"] = float(o["num_tpus"])
+    if o.get("memory"):
+        res["memory"] = float(o["memory"])
+    return {k: v for k, v in res.items() if v}
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: dict):
+        self._fn = fn
+        self._options = options
+        self._exported_key: Optional[str] = None
+        self._fn_blob: Optional[bytes] = None
+        self.__name__ = getattr(fn, "__name__", "anonymous")
+
+    def options(self, **overrides):
+        bad = set(overrides) - _VALID_OPTIONS
+        if bad:
+            raise ValueError(f"invalid options: {bad}")
+        merged = {**self._options, **overrides}
+        rf = RemoteFunction(self._fn, merged)
+        rf._fn_blob = self._fn_blob
+        return rf
+
+    def remote(self, *args, **kwargs):
+        _ensure_init()
+        if self._fn_blob is None:
+            self._fn_blob = cloudpickle.dumps(self._fn)
+        key = _export(self._fn_blob, "fn")
+        o = self._options
+        task_id = TaskID.from_random()
+        num_returns = o.get("num_returns", 1)
+        streaming = num_returns == "streaming" or num_returns == "dynamic"
+        n_ret = 1 if streaming else num_returns
+        return_ids = [
+            ObjectID.for_task_return(task_id, i) for i in range(n_ret)
+        ]
+        args_blob, arg_ids, args_ref = _pack_args(args, kwargs)
+        cfg = get_config()
+        spec = {
+            "task_id": task_id.binary(),
+            "name": o.get("name") or self.__name__,
+            "func_key": key,
+            "args": args_blob,
+            "args_ref": args_ref,
+            "arg_ids": arg_ids,
+            "num_returns": "streaming" if streaming else num_returns,
+            "return_ids": [r.binary() for r in return_ids],
+            "resources": _resources_from_options(o),
+            "strategy": _strategy_wire(o.get("scheduling_strategy")),
+            "max_retries": o.get("max_retries", cfg.default_task_max_retries),
+            "retry_exceptions": bool(o.get("retry_exceptions", False)),
+            "runtime_env": o.get("runtime_env"),
+        }
+        ctx.client.call("submit_task", spec)
+        if streaming:
+            return ObjectRefGenerator(task_id.binary())
+        refs = [ObjectRef(r) for r in return_ids]
+        return refs[0] if n_ret == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use {self.__name__}.remote()."
+        )
+
+
+# -------------------------------------------------------------------- actors
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+        self._options: dict = {}
+
+    def options(self, **overrides):
+        m = ActorMethod(self._handle, self._name)
+        m._options = {**self._options, **overrides}
+        return m
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit(self._name, args, kwargs, self._options)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names: List[str],
+                 max_task_retries: int = 0, class_name: str = ""):
+        self._actor_id = actor_id
+        self._method_names = method_names
+        self._max_task_retries = max_task_retries
+        self._class_name = class_name
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name} has no method {name!r}"
+            )
+        return ActorMethod(self, name)
+
+    def _submit(self, method_name: str, args, kwargs, options: dict):
+        _ensure_init()
+        task_id = TaskID.from_random()
+        num_returns = options.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        n_ret = 1 if streaming else num_returns
+        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(n_ret)]
+        args_blob, arg_ids, args_ref = _pack_args(args, kwargs)
+        spec = {
+            "task_id": task_id.binary(),
+            "actor_id": self._actor_id.binary(),
+            "method_name": method_name,
+            "name": f"{self._class_name}.{method_name}",
+            "args": args_blob,
+            "args_ref": args_ref,
+            "arg_ids": arg_ids,
+            "num_returns": "streaming" if streaming else num_returns,
+            "return_ids": [r.binary() for r in return_ids],
+            "max_retries": self._max_task_retries,
+        }
+        ctx.client.call("submit_actor_task", spec)
+        if streaming:
+            return ObjectRefGenerator(task_id.binary())
+        refs = [ObjectRef(r) for r in return_ids]
+        return refs[0] if n_ret == 1 else refs
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._method_names, self._max_task_retries,
+             self._class_name),
+        )
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    def __init__(self, cls, options: dict):
+        self._cls = cls
+        self._options = options
+        self._cls_blob: Optional[bytes] = None
+        self.__name__ = cls.__name__
+
+    def options(self, **overrides):
+        bad = set(overrides) - _VALID_OPTIONS
+        if bad:
+            raise ValueError(f"invalid options: {bad}")
+        ac = ActorClass(self._cls, {**self._options, **overrides})
+        ac._cls_blob = self._cls_blob
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        _ensure_init()
+        if self._cls_blob is None:
+            self._cls_blob = cloudpickle.dumps(self._cls)
+        key = _export(self._cls_blob, "cls")
+        o = self._options
+        cfg = get_config()
+        actor_id = ActorID.from_random()
+        task_id = TaskID.from_random()
+        args_blob, arg_ids, args_ref = _pack_args(args, kwargs)
+        method_names = [
+            n for n, _ in inspect.getmembers(self._cls, callable)
+            if not n.startswith("__")
+        ]
+        creation_task = {
+            "task_id": task_id.binary(),
+            "name": f"{self.__name__}.__init__",
+            "func_key": key,
+            "args": args_blob,
+            "args_ref": args_ref,
+            "arg_ids": arg_ids,
+            "num_returns": 1,
+            "return_ids": [ObjectID.for_task_return(task_id, 0).binary()],
+            # Actors reserve no CPU by default (matching the reference:
+            # actors get a dedicated worker process, not a CPU slot).
+            "resources": _resources_from_options(o, default_cpu=0.0),
+            "strategy": _strategy_wire(o.get("scheduling_strategy")),
+            "max_retries": 0,
+            "is_actor_creation": True,
+            "actor_id": actor_id.binary(),
+            "max_concurrency": o.get("max_concurrency", 1),
+            "runtime_env": o.get("runtime_env"),
+        }
+        spec = {
+            "actor_id": actor_id.binary(),
+            "class_name": self.__name__,
+            "name": o.get("name"),
+            "namespace": o.get("namespace", ctx.namespace),
+            "max_restarts": o.get("max_restarts", cfg.default_actor_max_restarts),
+            "max_task_retries": o.get("max_task_retries", 0),
+            "method_names": method_names,
+            "lifetime": o.get("lifetime"),
+            "creation_task": creation_task,
+        }
+        ctx.client.call("create_actor", spec)
+        return ActorHandle(
+            actor_id, method_names, spec["max_task_retries"], self.__name__
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()."
+        )
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    _ensure_init()
+    reply = ctx.client.call("get_actor_by_name", {"name": name})
+    if not reply["found"]:
+        raise ValueError(f"no actor with name {name!r}")
+    spec = reply["spec"]
+    return ActorHandle(
+        ActorID(reply["actor_id"]),
+        spec["method_names"],
+        spec.get("max_task_retries", 0),
+        spec.get("class_name", ""),
+    )
+
+
+def list_named_actors() -> List[str]:
+    _ensure_init()
+    return ctx.client.call("list_named_actors")["names"]
+
+
+# ------------------------------------------------------------------ decorator
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes."""
+    bad = set(options) - _VALID_OPTIONS
+    if bad:
+        raise ValueError(f"invalid @remote options: {bad}")
+
+    def wrap(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return wrap(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return wrap
+
+
+# ------------------------------------------------------------ placement group
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+) -> PlacementGroup:
+    _ensure_init()
+    pg_id = PlacementGroupID.from_random()
+    reply = ctx.client.call(
+        "create_placement_group",
+        {
+            "pg_id": pg_id.binary(),
+            "bundles": bundles,
+            "strategy": strategy,
+            "name": name,
+        },
+    )
+    if not reply["created"]:
+        raise RuntimeError(
+            f"placement group infeasible: bundles={bundles} strategy={strategy}"
+        )
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    _ensure_init()
+    ctx.client.call("remove_placement_group", {"pg_id": pg.id.binary()})
+
+
+# ------------------------------------------------------------- introspection
+
+
+def cluster_resources() -> Dict[str, float]:
+    _ensure_init()
+    return ctx.client.call("cluster_resources")["resources"]
+
+
+def available_resources() -> Dict[str, float]:
+    _ensure_init()
+    return ctx.client.call("available_resources")["resources"]
+
+
+def nodes() -> List[dict]:
+    _ensure_init()
+    return ctx.client.call("list_state", {"kind": "nodes"})["items"]
+
+
+def timeline() -> List[dict]:
+    _ensure_init()
+    return ctx.client.call("list_state", {"kind": "timeline"})["items"]

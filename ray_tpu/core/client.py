@@ -1,0 +1,207 @@
+"""Client: a process's connection to the control plane + object store.
+
+Role-equivalent to the reference CoreWorker's client surface
+(reference: src/ray/core_worker/core_worker.h:295 — Put/Get/Wait/SubmitTask/
+CreateActor/SubmitActorTask) minus task execution, which lives in
+worker_main.py.  One Client per process (driver or worker).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .. import exceptions
+from . import serialization
+from .config import get_config
+from .ids import NodeID, ObjectID
+from .object_store import StoreClient
+from .rpc import RpcClient
+
+
+class Client:
+    def __init__(
+        self,
+        head_addr: str,
+        kind: str,
+        worker_id: Optional[bytes] = None,
+        node_id: Optional[bytes] = None,
+        pid: int = 0,
+    ):
+        host, port = head_addr.rsplit(":", 1)
+        self.rpc = RpcClient(host, int(port), name=f"{kind}-rpc")
+        body: Dict[str, Any] = {"kind": kind, "pid": pid}
+        if worker_id is not None:
+            body["worker_id"] = worker_id
+        if node_id is not None:
+            body["node_id"] = node_id
+        reply = self.rpc.call("register", body)
+        self.session: str = reply["session"]
+        self.node_id: Optional[NodeID] = (
+            NodeID(node_id) if node_id else
+            (NodeID(reply["node_id"]) if reply.get("node_id") else None)
+        )
+        self.kind = kind
+        self._stores: Dict[str, StoreClient] = {}
+        self._sub_handlers: Dict[str, List[Callable]] = {}
+        self._sub_lock = threading.Lock()
+        self.rpc.on_push("pubsub", self._on_pubsub)
+        self.rpc.on_push("object_free", self._on_object_free)
+
+    # -- stores ----------------------------------------------------------------
+
+    def store(self, session: Optional[str] = None) -> StoreClient:
+        session = session or self.session
+        st = self._stores.get(session)
+        if st is None:
+            st = self._stores[session] = StoreClient(session)
+        return st
+
+    def _on_object_free(self, body):
+        for raw in body.get("object_ids", []):
+            for st in self._stores.values():
+                st.detach(ObjectID(raw))
+
+    # -- objects ---------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectID:
+        oid = ObjectID.from_random()
+        self.put_with_id(oid, value)
+        return oid
+
+    def put_with_id(self, oid: ObjectID, value: Any) -> int:
+        cfg = get_config()
+        meta, buffers = serialization.serialize(value)
+        size = serialization.packed_size(meta, buffers)
+        if size <= cfg.inline_object_max_bytes:
+            blob = bytearray(size)
+            serialization.pack_into(meta, buffers, memoryview(blob))
+            self.rpc.call("put_object", {"object_id": oid.binary(),
+                                         "inline": bytes(blob)})
+        else:
+            buf = self.store().create(oid, size)
+            serialization.pack_into(meta, buffers, buf)
+            self.rpc.call(
+                "put_object",
+                {"object_id": oid.binary(), "size": size,
+                 "node_id": self.node_id.binary()},
+            )
+        return size
+
+    def get_raw(self, object_ids: Sequence[ObjectID], timeout: float = -1.0):
+        """Fetch wire descriptors for objects (blocking until sealed)."""
+        reply = self.rpc.call(
+            "get_objects",
+            {"object_ids": [o.binary() for o in object_ids], "timeout": timeout},
+            timeout=1e9 if timeout < 0 else timeout + 30,
+        )
+        return reply["objects"]
+
+    def get(self, refs: Sequence, timeout: float = -1.0) -> List[Any]:
+        object_ids = [r.object_id for r in refs]
+        descs = self.get_raw(object_ids, timeout)
+        out = []
+        for oid, desc in zip(object_ids, descs):
+            if desc.get("timeout"):
+                raise exceptions.GetTimeoutError(
+                    f"ray_tpu.get timed out after {timeout}s on {oid}"
+                )
+            out.append(self._materialize(oid, desc))
+        return out
+
+    def _materialize(self, oid: ObjectID, desc: dict) -> Any:
+        if desc.get("error") is not None:
+            raise serialization.unpack(desc["error"])
+        if desc.get("inline") is not None:
+            return serialization.unpack(desc["inline"])
+        view = self.store(desc["session"]).get(oid, timeout=2.0)
+        if view is None:
+            # Segment may have been spilled to disk; ask the store daemon to
+            # restore it, then retry the attach.
+            if self.rpc.call(
+                "restore_object", {"object_id": oid.binary()}
+            ).get("ok"):
+                view = self.store(desc["session"]).get(oid, timeout=2.0)
+        if view is None:
+            raise exceptions.ObjectLostError(
+                f"object {oid} location lost (node died?); "
+                "lineage reconstruction not available for this object"
+            )
+        return serialization.unpack(view)
+
+    def wait(self, refs: Sequence, num_returns: int, timeout: float):
+        reply = self.rpc.call(
+            "wait_objects",
+            {
+                "object_ids": [r.object_id.binary() for r in refs],
+                "num_returns": num_returns,
+                "timeout": timeout,
+            },
+            timeout=1e9 if timeout < 0 else timeout + 30,
+        )
+        ready_set = set(reply["ready"])
+        ready = [r for r in refs if r.object_id.binary() in ready_set]
+        not_ready = [r for r in refs if r.object_id.binary() not in ready_set]
+        return ready, not_ready
+
+    def free_objects(self, raw_ids: List[bytes]):
+        self.rpc.call("free_objects", {"object_ids": raw_ids})
+
+    def add_reference(self, raw_id: bytes):
+        try:
+            self.rpc.call("add_object_ref", {"object_ids": [raw_id]})
+        except Exception:
+            pass
+
+    def next_stream_item(self, task_id: bytes, index: int) -> dict:
+        return self.rpc.call(
+            "next_stream_item", {"task_id": task_id, "index": index},
+            timeout=1e9,
+        )
+
+    # -- KV --------------------------------------------------------------------
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self.rpc.call(
+            "kv_put", {"key": key, "value": value, "overwrite": overwrite}
+        )["added"]
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.rpc.call("kv_get", {"key": key})["value"]
+
+    def kv_del(self, key: str) -> bool:
+        return self.rpc.call("kv_del", {"key": key})["deleted"]
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.rpc.call("kv_keys", {"prefix": prefix})["keys"]
+
+    # -- pubsub ----------------------------------------------------------------
+
+    def _on_pubsub(self, body):
+        with self._sub_lock:
+            handlers = list(self._sub_handlers.get(body["topic"], ()))
+        for fn in handlers:
+            try:
+                fn(body["data"])
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def subscribe(self, topic: str, handler: Callable[[Any], None]):
+        with self._sub_lock:
+            self._sub_handlers.setdefault(topic, []).append(handler)
+        self.rpc.call("subscribe", {"topic": topic})
+
+    def publish(self, topic: str, data: Any):
+        self.rpc.call("publish", {"topic": topic, "data": data})
+
+    # -- passthrough -----------------------------------------------------------
+
+    def call(self, method: str, body=None, timeout: float = 60.0):
+        return self.rpc.call(method, body, timeout=timeout)
+
+    def close(self):
+        for st in self._stores.values():
+            st.close()
+        self.rpc.close()

@@ -1,0 +1,90 @@
+"""Central configuration flag table.
+
+Equivalent in role to the reference's RAY_CONFIG macro table
+(reference: src/ray/common/ray_config_def.h — 215 flags, overridable via
+RAY_<flag> env vars): one declarative registry, env-var overridable with the
+``RT_`` prefix, plus per-``init()`` overrides via ``system_config={...}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict
+
+
+def _env_override(name: str, default):
+    raw = os.environ.get(f"RT_{name.upper()}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class Config:
+    # -- object store ---------------------------------------------------------
+    # Objects smaller than this are inlined in RPC messages instead of going
+    # through shared memory (analog of Ray's in-process memory store for small
+    # objects, reference: src/ray/core_worker/store_provider/memory_store).
+    inline_object_max_bytes: int = 100 * 1024
+    # Total shared-memory budget per node before eviction/spilling kicks in.
+    object_store_memory: int = 2 * 1024**3
+    # Directory used for spilling objects under memory pressure
+    # (reference: python/ray/_private/external_storage.py FileSystemStorage).
+    spill_dir: str = "/tmp/ray_tpu_spill"
+    # -- scheduler ------------------------------------------------------------
+    # Hybrid policy: pack onto low-index nodes until utilization crosses this
+    # threshold, then spread (reference:
+    # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Cap on concurrent pending lease requests per scheduling class
+    # (reference: normal_task_submitter.h max_pending_lease_requests).
+    max_pending_leases_per_scheduling_class: int = 10
+    # -- workers --------------------------------------------------------------
+    num_workers: int = 0  # 0 => num_cpus
+    worker_register_timeout_s: float = 30.0
+    idle_worker_killing_time_s: float = 300.0
+    # -- fault tolerance ------------------------------------------------------
+    default_task_max_retries: int = 3
+    default_actor_max_restarts: int = 0
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+    # -- RPC ------------------------------------------------------------------
+    rpc_connect_timeout_s: float = 10.0
+    rpc_max_message_bytes: int = 512 * 1024 * 1024
+    # -- observability --------------------------------------------------------
+    task_events_buffer_size: int = 100_000
+    enable_timeline: bool = True
+
+    def apply_env_overrides(self) -> "Config":
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+        return self
+
+    def apply_overrides(self, overrides: Dict[str, Any] | None) -> "Config":
+        for k, v in (overrides or {}).items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown system_config key: {k}")
+            setattr(self, k, v)
+        return self
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_env_overrides()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

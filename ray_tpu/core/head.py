@@ -1,0 +1,1177 @@
+"""Control plane: object directory, task scheduling/dispatch, actor lifecycle,
+placement groups, KV store, pubsub, worker-pool management.
+
+Role-equivalent to the reference's GCS server + raylet combination
+(reference: src/ray/gcs/gcs_server/gcs_server.h:78 — actor/node/job/PG/KV/
+pubsub services; src/ray/raylet/node_manager.h:119 — leasing + dispatch;
+src/ray/core_worker/task_manager.h:208 — retries + lineage).  Design choice
+vs the reference: ownership of the object directory and the task table is
+centralized in this process rather than distributed across core workers —
+a deliberately simpler protocol (single writer, no borrowing dance) that a
+TPU cluster's scale profile (hundreds of hosts, gang-scheduled SPMD jobs)
+tolerates well; scale-out path is sharding the table, not distributing
+ownership.
+
+All state is owned by one asyncio loop — handlers never block.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Set
+
+from . import serialization
+from ..exceptions import ActorDiedError, TaskCancelledError, WorkerCrashedError
+from .config import Config
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .rpc import Connection, RpcServer
+from .scheduler import ClusterScheduler, SchedulingStrategy
+
+# Worker / actor / task states (subset of the reference FSMs:
+# gcs_actor_manager.h actor FSM, worker_pool.h worker states).
+STARTING, IDLE, LEASED, ACTOR, DEAD = "starting", "idle", "leased", "actor", "dead"
+PENDING, RUNNING, FINISHED, FAILED = "PENDING", "RUNNING", "FINISHED", "FAILED"
+
+
+def _strategy_from_wire(d: Optional[dict]) -> SchedulingStrategy:
+    if not d:
+        return SchedulingStrategy.default()
+    return SchedulingStrategy(
+        kind=d.get("kind", "default"),
+        node_id=NodeID(d["node_id"]) if d.get("node_id") else None,
+        soft=d.get("soft", False),
+        pg_id=PlacementGroupID(d["pg_id"]) if d.get("pg_id") else None,
+        bundle_index=d.get("bundle_index", -1),
+    )
+
+
+class WorkerState:
+    def __init__(self, worker_id: WorkerID, node_id: NodeID, conn: Connection, pid: int):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.conn = conn
+        self.pid = pid
+        # Workers start in STARTING and flip to IDLE on the worker_ready
+        # handshake — dispatching before the worker has installed its push
+        # handlers would drop the task push.
+        self.state = STARTING
+        self.inflight: Set[TaskID] = set()  # tasks currently on this worker
+        self.actor_id: Optional[ActorID] = None
+        self.last_seen = time.monotonic()
+
+
+class TaskRecord:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.task_id = TaskID(spec["task_id"])
+        self.state = PENDING
+        self.pending_deps: Set[ObjectID] = set()
+        self.worker_id: Optional[WorkerID] = None
+        self.node_id: Optional[NodeID] = None
+        self.retries_left = spec.get("max_retries", 0)
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.error: Optional[str] = None
+
+    @property
+    def is_actor_task(self) -> bool:
+        return bool(self.spec.get("actor_id")) and not self.spec.get(
+            "is_actor_creation"
+        )
+
+    @property
+    def resources(self) -> Dict[str, float]:
+        # api.py always sends explicit resources; {} (e.g. zero-CPU actors)
+        # must stay empty, not fall back to 1 CPU.
+        res = self.spec.get("resources")
+        return dict(res) if res is not None else {"CPU": 1.0}
+
+    @property
+    def strategy(self) -> SchedulingStrategy:
+        return _strategy_from_wire(self.spec.get("strategy"))
+
+
+class ActorRecord:
+    def __init__(self, actor_id: ActorID, spec: dict):
+        self.actor_id = actor_id
+        self.spec = spec
+        self.state = "PENDING"  # PENDING|ALIVE|RESTARTING|DEAD
+        self.worker_id: Optional[WorkerID] = None
+        self.node_id: Optional[NodeID] = None
+        self.restarts_left = spec.get("max_restarts", 0)
+        self.name = spec.get("name") or ""
+        # Tasks queued while the actor is pending/restarting.
+        self.pending_tasks: deque = deque()
+        self.num_executed = 0
+        self.death_cause: Optional[str] = None
+
+
+class ObjectRecord:
+    __slots__ = (
+        "object_id", "size", "inline", "locations", "error",
+        "ref_count", "task_id", "sealed", "spilled",
+    )
+
+    def __init__(self, object_id: ObjectID):
+        self.object_id = object_id
+        self.size = 0
+        self.inline: Optional[bytes] = None
+        self.locations: Set[NodeID] = set()
+        self.error: Optional[bytes] = None  # serialized exception
+        self.ref_count = 1  # creator's reference
+        self.task_id: Optional[TaskID] = None
+        self.sealed = False
+        self.spilled = False
+
+
+class Head:
+    """The control-plane server."""
+
+    def __init__(self, config: Config, session: str, host: str = "127.0.0.1"):
+        self.config = config
+        self.session = session
+        self.server = RpcServer(host=host)
+        self.scheduler = ClusterScheduler(config.scheduler_spread_threshold)
+        self.host = host
+        self.port = 0
+
+        # Local node's store daemon: accounting, eviction, spill, cleanup.
+        from .object_store import ObjectStore
+
+        self.store = ObjectStore(
+            session, config.object_store_memory, config.spill_dir
+        )
+        self.kv: Dict[str, bytes] = {}
+        self.workers: Dict[WorkerID, WorkerState] = {}
+        self.conn_to_worker: Dict[int, WorkerID] = {}
+        self.tasks: Dict[TaskID, TaskRecord] = {}
+        self.tasks_waiting_on: Dict[ObjectID, Set[TaskID]] = {}
+        self.finished_tasks: deque = deque(maxlen=10_000)  # for the state API
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.objects: Dict[ObjectID, ObjectRecord] = {}
+        self.object_waiters: Dict[ObjectID, List[asyncio.Event]] = {}
+        self.queued_tasks: deque = deque()  # TaskRecords ready to schedule
+        self.stream_items: Dict[tuple, dict] = {}  # (task_id, idx) -> item info
+        self.stream_waiters: Dict[tuple, List[asyncio.Event]] = {}
+        self.stream_done: Dict[TaskID, int] = {}  # total item count when finished
+        self.subs: Dict[str, Set[int]] = {}  # topic -> conn ids
+        self.node_sessions: Dict[NodeID, str] = {}  # store session per node
+        self.node_worker_caps: Dict[NodeID, int] = {}
+        self.node_worker_counts: Dict[NodeID, int] = {}
+        self.local_node_id: Optional[NodeID] = None
+        self.worker_procs: List[subprocess.Popen] = []
+        self.node_daemons: Dict[NodeID, Connection] = {}
+        self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
+        self._spawn_pending: Dict[NodeID, int] = {}
+        self._shutdown = False
+        self.job_start_time = time.time()
+
+        for name in [
+            "register", "kv_put", "kv_get", "kv_del", "kv_keys",
+            "submit_task", "create_actor", "submit_actor_task",
+            "task_done", "stream_item", "put_object", "get_objects",
+            "wait_objects", "free_objects", "add_object_ref",
+            "create_placement_group", "remove_placement_group",
+            "kill_actor", "cancel_task", "get_actor_by_name", "list_named_actors",
+            "worker_ready",
+            "publish", "subscribe", "cluster_resources", "available_resources",
+            "next_stream_item", "list_state", "ping", "shutdown_cluster",
+            "actor_restarting", "restore_object", "store_stats",
+        ]:
+            self.server.register(name, getattr(self, f"h_{name}"))
+        self.server.on_disconnect = self._on_disconnect
+
+    # ------------------------------------------------------------------ utils
+
+    def _event(self, kind: str, **kw):
+        if self.config.enable_timeline:
+            self.task_events.append({"ts": time.time(), "kind": kind, **kw})
+
+    def _obj(self, oid: ObjectID) -> ObjectRecord:
+        rec = self.objects.get(oid)
+        if rec is None:
+            rec = self.objects[oid] = ObjectRecord(oid)
+        return rec
+
+    def _notify_object_ready(self, oid: ObjectID):
+        for ev in self.object_waiters.pop(oid, []):
+            ev.set()
+        # Unblock tasks waiting on this dependency (indexed, not scanned).
+        drained_actors = set()
+        for tid in self.tasks_waiting_on.pop(oid, ()):
+            task = self.tasks.get(tid)
+            if task is None or task.state != PENDING:
+                continue
+            task.pending_deps.discard(oid)
+            if task.pending_deps:
+                continue
+            if task.is_actor_task:
+                # Actor tasks stay in the actor's FIFO queue; a newly
+                # dep-free head-of-queue can now drain.
+                aid = ActorID(task.spec["actor_id"])
+                if aid not in drained_actors:
+                    drained_actors.add(aid)
+                    actor = self.actors.get(aid)
+                    if actor is not None and actor.state == "ALIVE":
+                        asyncio.ensure_future(self._drain_actor_queue(actor))
+            elif task not in self.queued_tasks:
+                self.queued_tasks.append(task)
+        self._kick()
+
+    def _kick(self):
+        """Schedule a dispatch pass on the loop."""
+        asyncio.get_running_loop().call_soon(
+            lambda: asyncio.ensure_future(self._dispatch_loop())
+        )
+
+    # -------------------------------------------------------------- lifecycle
+
+    async def start(self) -> int:
+        self.port = await self.server.start()
+        return self.port
+
+    async def stop(self):
+        self._shutdown = True
+        for w in self.workers.values():
+            if w.conn.alive:
+                try:
+                    await w.conn.push("shutdown", {})
+                except Exception:
+                    pass
+        await asyncio.sleep(0.05)
+        for p in self.worker_procs:
+            if p.poll() is None:
+                p.terminate()
+        await self.server.stop()
+        self.store.shutdown()
+
+    def add_local_node(self, resources: Dict[str, float], num_workers: int,
+                       labels: Optional[Dict[str, str]] = None) -> NodeID:
+        node_id = NodeID.from_random()
+        self.scheduler.add_node(node_id, resources, labels)
+        self.local_node_id = node_id
+        self.node_sessions[node_id] = self.session
+        self.node_worker_caps[node_id] = num_workers
+        self.node_worker_counts[node_id] = 0
+        self._spawn_pending[node_id] = 0
+        return node_id
+
+    def _spawn_worker(self, node_id: NodeID):
+        """Spawn a worker process for a node (local nodes only; remote nodes
+        get a spawn_worker push to their daemon)."""
+        env = dict(os.environ)
+        # CPU workers must not claim the TPU: strip accelerator-session env so
+        # plugin sitecustomize hooks (axon tunnel, libtpu) stay dormant.  The
+        # analog of the reference's TPU_VISIBLE_CHIPS isolation
+        # (python/ray/_private/accelerators/tpu.py:155) — a worker only sees
+        # chips explicitly granted to it.
+        for k in list(env):
+            if k.startswith(("PALLAS_AXON", "TPU_", "AXON_")):
+                env.pop(k)
+        # Ensure workers can import ray_tpu regardless of the driver's cwd.
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_parent
+        )
+        env.update(
+            RT_HEAD_ADDR=f"{self.host}:{self.port}",
+            RT_NODE_ID=node_id.hex(),
+            RT_SESSION=self.node_sessions[node_id],
+            # Workers default to CPU so they never grab the TPU from under the
+            # driver; tasks that need the chip opt in via resources={"TPU": n}
+            # + runtime_env (see worker_main._maybe_enable_tpu).
+            JAX_PLATFORMS=env_jax_platform(),
+        )
+        daemon = self.node_daemons.get(node_id)
+        self._spawn_pending[node_id] = self._spawn_pending.get(node_id, 0) + 1
+        if daemon is not None:
+            asyncio.ensure_future(daemon.push("spawn_worker", {}))
+            return
+        log_dir = os.path.join("/tmp/ray_tpu_logs", self.session)
+        os.makedirs(log_dir, exist_ok=True)
+        logf = open(
+            os.path.join(log_dir, f"worker-{time.time_ns()}.log"), "wb"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+        )
+        logf.close()
+        self.worker_procs.append(proc)
+
+    # ------------------------------------------------------------- handlers
+
+    async def h_ping(self, conn, body):
+        return {"ok": True, "session": self.session}
+
+    async def h_register(self, conn, body):
+        kind = body["kind"]
+        if kind == "worker":
+            worker_id = WorkerID(body["worker_id"])
+            node_id = NodeID(body["node_id"])
+            w = WorkerState(worker_id, node_id, conn, body.get("pid", 0))
+            self.workers[worker_id] = w
+            self.conn_to_worker[conn.conn_id] = worker_id
+            conn.meta["kind"] = "worker"
+            if self._spawn_pending.get(node_id, 0) > 0:
+                self._spawn_pending[node_id] -= 1
+            self.node_worker_counts[node_id] = (
+                self.node_worker_counts.get(node_id, 0) + 1
+            )
+            return {"session": self.session}
+        if kind == "node":
+            node_id = NodeID(body["node_id"]) if body.get("node_id") else NodeID.from_random()
+            self.scheduler.add_node(node_id, body["resources"], body.get("labels"))
+            self.node_sessions[node_id] = body.get("store_session", self.session)
+            self.node_worker_caps[node_id] = body.get("num_workers", 4)
+            self.node_worker_counts[node_id] = 0
+            self._spawn_pending[node_id] = 0
+            self.node_daemons[node_id] = conn
+            conn.meta["kind"] = "node"
+            conn.meta["node_id"] = node_id
+            self._kick()
+            return {"session": self.session, "node_id": node_id.binary()}
+        conn.meta["kind"] = kind  # driver
+        return {
+            "session": self.session,
+            "node_id": self.local_node_id.binary() if self.local_node_id else b"",
+        }
+
+    async def _on_disconnect(self, conn: Connection):
+        worker_id = self.conn_to_worker.pop(conn.conn_id, None)
+        if worker_id is not None:
+            await self._handle_worker_death(worker_id)
+        node_id = conn.meta.get("node_id")
+        if node_id is not None and conn.meta.get("kind") == "node":
+            self.node_daemons.pop(node_id, None)
+            self.scheduler.remove_node(node_id)
+            for w in [w for w in self.workers.values() if w.node_id == node_id]:
+                await self._handle_worker_death(w.worker_id)
+        for topic_subs in self.subs.values():
+            topic_subs.discard(conn.conn_id)
+
+    # -- KV (reference: gcs_kv_manager.h) -------------------------------------
+
+    async def h_kv_put(self, conn, body):
+        key = body["key"]
+        if body.get("overwrite", True) or key not in self.kv:
+            self.kv[key] = body["value"]
+            return {"added": True}
+        return {"added": False}
+
+    async def h_kv_get(self, conn, body):
+        return {"value": self.kv.get(body["key"])}
+
+    async def h_kv_del(self, conn, body):
+        return {"deleted": self.kv.pop(body["key"], None) is not None}
+
+    async def h_kv_keys(self, conn, body):
+        prefix = body.get("prefix", "")
+        return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    # -- objects ---------------------------------------------------------------
+
+    async def h_put_object(self, conn, body):
+        """Driver/worker ray.put: object already written to shm (or inline)."""
+        oid = ObjectID(body["object_id"])
+        rec = self._obj(oid)
+        if body.get("inline") is not None:
+            rec.inline = body["inline"]
+            rec.size = len(rec.inline)
+        else:
+            rec.size = body["size"]
+            node_id = NodeID(body["node_id"])
+            rec.locations.add(node_id)
+            self._adopt_local(oid, node_id)
+        rec.sealed = True
+        rec.ref_count = max(rec.ref_count, 1)
+        self._notify_object_ready(oid)
+        return {}
+
+    def _adopt_local(self, oid: ObjectID, node_id: Optional[NodeID]):
+        """Account a shm object in the local store daemon (enables eviction,
+        spilling, and shutdown cleanup)."""
+        if node_id == self.local_node_id:
+            try:
+                self.store.adopt(oid)
+            except (FileNotFoundError, MemoryError):
+                pass
+
+    async def h_restore_object(self, conn, body):
+        """Re-materialize a spilled object into shm so a reader can attach."""
+        view = self.store.get(ObjectID(body["object_id"]))
+        return {"ok": view is not None}
+
+    async def h_store_stats(self, conn, body):
+        return self.store.stats()
+
+    async def h_add_object_ref(self, conn, body):
+        for raw in body["object_ids"]:
+            self._obj(ObjectID(raw)).ref_count += 1
+        return {}
+
+    async def h_free_objects(self, conn, body):
+        freed = []
+        for raw in body["object_ids"]:
+            oid = ObjectID(raw)
+            rec = self.objects.get(oid)
+            if rec is None:
+                continue
+            rec.ref_count -= 1
+            if rec.ref_count <= 0:
+                self.objects.pop(oid, None)
+                self.store.free(oid)
+                freed.append(raw)
+        if freed:
+            await self._broadcast_to_nodes("free_objects", {"object_ids": freed})
+        return {"num_freed": len(freed)}
+
+    async def _broadcast_to_nodes(self, method, body):
+        for conn in list(self.node_daemons.values()):
+            try:
+                await conn.push(method, body)
+            except Exception:
+                pass
+        # The driver process frees local-node segments (see api.Client).
+        await self._publish("object_free", body)
+
+    def _object_wire(self, rec: ObjectRecord) -> dict:
+        if rec.error is not None:
+            return {"error": rec.error}
+        if rec.inline is not None:
+            return {"inline": rec.inline}
+        loc = next(iter(rec.locations), None)
+        return {
+            "size": rec.size,
+            "session": self.node_sessions.get(loc, self.session),
+            "node_id": loc.binary() if loc else None,
+        }
+
+    async def h_get_objects(self, conn, body):
+        timeout = body.get("timeout", -1.0)
+        deadline = None if timeout < 0 else time.monotonic() + timeout
+        out = []
+        for raw in body["object_ids"]:
+            oid = ObjectID(raw)
+            rec = self._obj(oid)
+            while not rec.sealed:
+                ev = asyncio.Event()
+                self.object_waiters.setdefault(oid, []).append(ev)
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    out.append({"timeout": True})
+                    break
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    out.append({"timeout": True})
+                    break
+            else:
+                out.append(self._object_wire(rec))
+        return {"objects": out}
+
+    async def h_wait_objects(self, conn, body):
+        oids = [ObjectID(raw) for raw in body["object_ids"]]
+        num_returns = body.get("num_returns", 1)
+        timeout = body.get("timeout", -1.0)
+        deadline = None if timeout < 0 else time.monotonic() + timeout
+
+        def ready_ids():
+            return [o for o in oids if self.objects.get(o) and self.objects[o].sealed]
+
+        while len(ready_ids()) < num_returns:
+            evs = []
+            for o in oids:
+                rec = self._obj(o)
+                if not rec.sealed:
+                    ev = asyncio.Event()
+                    self.object_waiters.setdefault(o, []).append(ev)
+                    evs.append(ev)
+            if not evs:
+                break
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            waits = [asyncio.ensure_future(e.wait()) for e in evs]
+            done, pending = await asyncio.wait(
+                waits, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+            )
+            for p in pending:
+                p.cancel()
+            if not done:
+                break
+        ready = set(ready_ids())
+        return {
+            "ready": [o.binary() for o in oids if o in ready],
+            "not_ready": [o.binary() for o in oids if o not in ready],
+        }
+
+    # -- tasks -----------------------------------------------------------------
+
+    def _register_task(self, task: TaskRecord):
+        """Common bookkeeping: return-object lineage, dependency tracking, and
+        pinning of argument objects for the task's lifetime (the simplified
+        analog of the reference's borrowed-reference pinning,
+        reference_count.h:61)."""
+        self.tasks[task.task_id] = task
+        for raw in task.spec.get("return_ids", []):
+            self._obj(ObjectID(raw)).task_id = task.task_id
+        for raw in task.spec.get("arg_ids", []):
+            oid = ObjectID(raw)
+            rec = self._obj(oid)
+            rec.ref_count += 1  # unpinned at task finalization
+            if not rec.sealed:
+                task.pending_deps.add(oid)
+                self.tasks_waiting_on.setdefault(oid, set()).add(task.task_id)
+
+    def _finalize_task(self, task: TaskRecord):
+        """Terminal-state cleanup: unpin args, prune the record."""
+        for raw in task.spec.get("arg_ids", []):
+            oid = ObjectID(raw)
+            rec = self.objects.get(oid)
+            if rec is not None:
+                rec.ref_count -= 1
+                if rec.ref_count <= 0:
+                    self.objects.pop(oid, None)
+                    self.store.free(oid)
+            waiting = self.tasks_waiting_on.get(oid)
+            if waiting is not None:
+                waiting.discard(task.task_id)
+                if not waiting:
+                    self.tasks_waiting_on.pop(oid, None)
+        self.finished_tasks.append(
+            {
+                "task_id": task.task_id.hex(),
+                "name": task.spec.get("name", ""),
+                "state": task.state,
+                "start_time": task.start_time,
+                "end_time": task.end_time,
+                "error": task.error,
+            }
+        )
+        # Streaming task records stay (next_stream_item consults their state);
+        # creation task records stay while the actor lives (its death releases
+        # the creation resources via this record).
+        if not (
+            task.spec.get("num_returns") == "streaming"
+            or task.spec.get("is_actor_creation")
+        ):
+            self.tasks.pop(task.task_id, None)
+
+    async def h_submit_task(self, conn, body):
+        task = TaskRecord(body)
+        self._register_task(task)
+        self._event("task_submitted", task=task.task_id.hex(), name=body.get("name", ""))
+        if not task.pending_deps:
+            self.queued_tasks.append(task)
+            self._kick()
+        return {}
+
+    async def _dispatch_loop(self):
+        """Single dispatch pass: match queued tasks to idle workers.
+
+        The analog of LocalTaskManager::ScheduleAndDispatchTasks
+        (reference: src/ray/raylet/local_task_manager.h:58)."""
+        if self._shutdown:
+            return
+        made_progress = True
+        while made_progress and self.queued_tasks:
+            made_progress = False
+            requeue: List[TaskRecord] = []
+            while self.queued_tasks:
+                task = self.queued_tasks.popleft()
+                if task.state != PENDING:
+                    continue
+                node_id = self.scheduler.pick_node(task.resources, task.strategy)
+                if node_id is None:
+                    requeue.append(task)
+                    continue
+                worker = self._find_idle_worker(node_id)
+                if worker is None:
+                    # Actors get dedicated processes beyond the task-worker
+                    # cap; plain tasks respect the cap.
+                    self._maybe_spawn(
+                        node_id,
+                        force=bool(task.spec.get("is_actor_creation")),
+                    )
+                    requeue.append(task)
+                    continue
+                if not self.scheduler.acquire(node_id, task.resources, task.strategy):
+                    requeue.append(task)
+                    continue
+                await self._dispatch(task, worker)
+                made_progress = True
+            self.queued_tasks.extend(requeue)
+
+    def _find_idle_worker(self, node_id: NodeID) -> Optional[WorkerState]:
+        for w in self.workers.values():
+            if w.node_id == node_id and w.state == IDLE and w.conn.alive:
+                return w
+        return None
+
+    def _maybe_spawn(self, node_id: NodeID, force: bool = False):
+        cap = self.node_worker_caps.get(node_id, 0)
+        # Actor-dedicated workers don't count against the task-worker pool cap
+        # (reference: worker_pool.h tracks dedicated vs shared workers).
+        count = sum(
+            1
+            for w in self.workers.values()
+            if w.node_id == node_id and w.state in (STARTING, IDLE, LEASED)
+        )
+        pending = self._spawn_pending.get(node_id, 0)
+        if count + pending < cap or (force and pending == 0):
+            self._spawn_worker(node_id)
+
+    async def _dispatch(self, task: TaskRecord, worker: WorkerState):
+        task.state = RUNNING
+        task.worker_id = worker.worker_id
+        task.node_id = worker.node_id
+        task.start_time = time.time()
+        is_actor_creation = task.spec.get("is_actor_creation", False)
+        worker.state = ACTOR if is_actor_creation else LEASED
+        worker.inflight.add(task.task_id)
+        self._event("task_dispatched", task=task.task_id.hex(),
+                    worker=worker.worker_id.hex())
+        if is_actor_creation:
+            actor_id = ActorID(task.spec["actor_id"])
+            actor = self.actors[actor_id]
+            actor.worker_id = worker.worker_id
+            actor.node_id = worker.node_id
+            worker.actor_id = actor_id
+        await worker.conn.push("execute_task", task.spec)
+
+    async def h_task_done(self, conn, body):
+        task_id = TaskID(body["task_id"])
+        task = self.tasks.get(task_id)
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        worker = self.workers.get(worker_id) if worker_id else None
+        if task is None:
+            return {}
+        failed = body.get("error") is not None
+        actor_creation = task.spec.get("is_actor_creation", False)
+
+        # Application-level retryable error: resubmit.
+        if failed and task.retries_left != 0 and body.get("retryable", False):
+            task.retries_left -= 1
+            task.state = PENDING
+            self._release_task_resources(task, worker)
+            task.worker_id = None
+            task.node_id = None
+            if task.is_actor_task:
+                actor = self.actors.get(ActorID(task.spec["actor_id"]))
+                if actor is not None and actor.state != "DEAD":
+                    actor.pending_tasks.appendleft(task)
+                    if actor.state == "ALIVE":
+                        await self._drain_actor_queue(actor)
+                    return {}
+                # fall through: actor gone, give up and record the failure
+                task.retries_left = 0
+            else:
+                self.queued_tasks.append(task)
+                self._kick()
+                return {}
+
+        task.state = FAILED if failed else FINISHED
+        task.end_time = time.time()
+        if failed:
+            task.error = body.get("error_repr", "")
+        for ret in body.get("returns", []):
+            oid = ObjectID(ret["object_id"])
+            rec = self._obj(oid)
+            if failed:
+                rec.error = body["error"]
+            elif ret.get("inline") is not None:
+                rec.error = None  # e.g. re-sealed by a restarted actor creation
+                rec.inline = ret["inline"]
+                rec.size = len(rec.inline)
+            else:
+                rec.error = None
+                rec.size = ret["size"]
+                loc = worker.node_id if worker else self.local_node_id
+                rec.locations.add(loc)
+                self._adopt_local(oid, loc)
+            rec.sealed = True
+            self._notify_object_ready(oid)
+        if task.spec.get("num_returns") == "streaming":
+            self.stream_done[task_id] = body.get("stream_count", 0)
+            for key, evs in list(self.stream_waiters.items()):
+                if key[0] == task_id.binary():
+                    for ev in self.stream_waiters.pop(key):
+                        ev.set()
+        self._event("task_done", task=task_id.hex(), failed=failed)
+
+        if actor_creation:
+            actor_id = ActorID(task.spec["actor_id"])
+            actor = self.actors.get(actor_id)
+            if actor:
+                if failed:
+                    actor.state = "DEAD"
+                    actor.death_cause = body.get("error_repr", "creation failed")
+                    await self._fail_actor_queue(actor, body.get("error"))
+                    if worker:
+                        worker.state = IDLE
+                        worker.actor_id = None
+                else:
+                    actor.state = "ALIVE"
+                    await self._publish(
+                        f"actor:{actor_id.hex()}", {"state": "ALIVE"}
+                    )
+                    await self._drain_actor_queue(actor)
+            self._release_task_resources(task, worker, keep_worker_busy=not failed)
+        elif task.spec.get("actor_id"):
+            actor = self.actors.get(ActorID(task.spec["actor_id"]))
+            if actor:
+                actor.num_executed += 1
+            self._release_task_resources(task, worker, keep_worker_busy=True)
+        else:
+            self._release_task_resources(task, worker)
+        self._finalize_task(task)
+        self._kick()
+        return {}
+
+    def _release_task_resources(self, task, worker, keep_worker_busy=False):
+        if task.is_actor_task:
+            release = False  # actor method tasks hold no scheduler resources
+        elif task.spec.get("is_actor_creation"):
+            # A live actor keeps its creation resources until death.
+            release = task.state in (FAILED, PENDING)
+        else:
+            release = True
+        if release and task.node_id is not None:
+            self.scheduler.release(task.node_id, task.resources, task.strategy)
+        if worker:
+            worker.inflight.discard(task.task_id)
+            if not keep_worker_busy:
+                worker.state = IDLE
+
+    async def h_stream_item(self, conn, body):
+        task_id = body["task_id"]
+        idx = body["index"]
+        oid = ObjectID(body["object_id"])
+        rec = self._obj(oid)
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        worker = self.workers.get(worker_id) if worker_id else None
+        if body.get("inline") is not None:
+            rec.inline = body["inline"]
+            rec.size = len(rec.inline)
+        else:
+            rec.size = body["size"]
+            loc = worker.node_id if worker else self.local_node_id
+            rec.locations.add(loc)
+            self._adopt_local(oid, loc)
+        rec.sealed = True
+        self.stream_items[(task_id, idx)] = {"object_id": body["object_id"]}
+        for ev in self.stream_waiters.pop((task_id, idx), []):
+            ev.set()
+        self._notify_object_ready(oid)
+        return {}
+
+    async def h_next_stream_item(self, conn, body):
+        task_id_raw = body["task_id"]
+        idx = body["index"]
+        key = (task_id_raw, idx)
+        tid = TaskID(task_id_raw)
+        while key not in self.stream_items:
+            if tid in self.stream_done and idx >= self.stream_done[tid]:
+                task = self.tasks.get(tid)
+                if task and task.state == FAILED:
+                    ret_ids = task.spec.get("return_ids") or []
+                    if ret_ids:
+                        rec = self.objects.get(ObjectID(ret_ids[0]))
+                        if rec is not None and rec.error is not None:
+                            return {"error": rec.error}
+                return {"done": True}
+            ev = asyncio.Event()
+            self.stream_waiters.setdefault(key, []).append(ev)
+            await ev.wait()
+        return {"object_id": self.stream_items[key]["object_id"]}
+
+    async def h_cancel_task(self, conn, body):
+        task_id = TaskID(body["task_id"])
+        task = self.tasks.get(task_id)
+        if task is None:
+            return {"cancelled": False}
+        if task.state == PENDING:
+            task.state = FAILED
+            task.error = "cancelled"
+            err = serialization.pack(TaskCancelledError(task_id.hex()))
+            for raw in task.spec.get("return_ids", []):
+                rec = self._obj(ObjectID(raw))
+                rec.error = err
+                rec.sealed = True
+                self._notify_object_ready(rec.object_id)
+            try:
+                self.queued_tasks.remove(task)
+            except ValueError:
+                pass
+            self._finalize_task(task)
+            return {"cancelled": True}
+        if task.state == RUNNING and task.worker_id:
+            w = self.workers.get(task.worker_id)
+            if w and w.conn.alive:
+                await w.conn.push("cancel", {"task_id": body["task_id"],
+                                             "force": body.get("force", False)})
+                return {"cancelled": True}
+        return {"cancelled": False}
+
+    # -- actors ----------------------------------------------------------------
+
+    async def h_create_actor(self, conn, body):
+        actor_id = ActorID(body["actor_id"])
+        actor = ActorRecord(actor_id, body)
+        if actor.name:
+            if actor.name in self.named_actors:
+                raise ValueError(f"actor name {actor.name!r} already taken")
+            self.named_actors[actor.name] = actor_id
+        self.actors[actor_id] = actor
+        await self.h_submit_task(conn, body["creation_task"])
+        return {}
+
+    async def h_submit_actor_task(self, conn, body):
+        actor_id = ActorID(body["actor_id"])
+        actor = self.actors.get(actor_id)
+        if actor is None or actor.state == "DEAD":
+            err = serialization.pack(
+                ActorDiedError(actor_id.hex(), actor.death_cause if actor else "unknown actor")
+            )
+            for raw in body.get("return_ids", []):
+                rec = self._obj(ObjectID(raw))
+                rec.error = err
+                rec.sealed = True
+                self._notify_object_ready(rec.object_id)
+            return {}
+        task = TaskRecord(body)
+        self._register_task(task)
+        # Strict per-actor FIFO: anything already queued keeps its place
+        # (reference: sequential_actor_submit_queue.h).
+        if actor.state != "ALIVE" or task.pending_deps or actor.pending_tasks:
+            actor.pending_tasks.append(task)
+            if actor.state == "ALIVE":
+                await self._drain_actor_queue(actor)
+            return {}
+        await self._push_actor_task(actor, task)
+        return {}
+
+    async def _push_actor_task(self, actor: ActorRecord, task: TaskRecord):
+        if task.state != PENDING:  # e.g. cancelled while queued
+            return
+        worker = self.workers.get(actor.worker_id)
+        if worker is None or not worker.conn.alive:
+            actor.pending_tasks.append(task)
+            return
+        task.state = RUNNING
+        task.worker_id = worker.worker_id
+        task.node_id = worker.node_id
+        task.start_time = time.time()
+        worker.inflight.add(task.task_id)
+        await worker.conn.push("execute_task", task.spec)
+
+    async def _drain_actor_queue(self, actor: ActorRecord):
+        while actor.pending_tasks:
+            task = actor.pending_tasks[0]
+            if task.state != PENDING:  # cancelled: drop and move on
+                actor.pending_tasks.popleft()
+                continue
+            if task.pending_deps:
+                break  # FIFO order: a dep-blocked head blocks the queue
+            actor.pending_tasks.popleft()
+            await self._push_actor_task(actor, task)
+
+    async def _fail_actor_queue(self, actor: ActorRecord, error: Optional[bytes]):
+        err = error or serialization.pack(
+            ActorDiedError(actor.actor_id.hex(), actor.death_cause or "actor died")
+        )
+        while actor.pending_tasks:
+            task = actor.pending_tasks.popleft()
+            task.state = FAILED
+            for raw in task.spec.get("return_ids", []):
+                rec = self._obj(ObjectID(raw))
+                rec.error = err
+                rec.sealed = True
+                self._notify_object_ready(rec.object_id)
+
+    async def h_kill_actor(self, conn, body):
+        actor_id = ActorID(body["actor_id"])
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"killed": False}
+        if body.get("no_restart", True):
+            actor.restarts_left = 0
+        worker = self.workers.get(actor.worker_id) if actor.worker_id else None
+        if worker is not None and worker.conn.alive:
+            # Push-based kill: works across nodes (the worker's RPC thread
+            # calls os._exit even if the main thread is busy).  Local workers
+            # also get a SIGKILL in case the process is wedged.
+            try:
+                await worker.conn.push("exit", {})
+            except Exception:
+                pass
+            if worker.node_id == self.local_node_id:
+                try:
+                    os.kill(worker.pid, 9)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        else:
+            actor.state = "DEAD"
+            actor.death_cause = "killed via kill_actor"
+            if actor.name:
+                self.named_actors.pop(actor.name, None)
+            await self._fail_actor_queue(actor, None)
+        return {"killed": True}
+
+    async def h_actor_restarting(self, conn, body):
+        return {}
+
+    async def h_worker_ready(self, conn, body):
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        w = self.workers.get(worker_id) if worker_id else None
+        if w is not None and w.state == STARTING:
+            w.state = IDLE
+            self._kick()
+        return {}
+
+    async def h_get_actor_by_name(self, conn, body):
+        actor_id = self.named_actors.get(body["name"])
+        if actor_id is None:
+            return {"found": False}
+        actor = self.actors[actor_id]
+        return {
+            "found": True,
+            "actor_id": actor_id.binary(),
+            "spec": {
+                k: actor.spec.get(k)
+                for k in ("class_name", "method_names", "max_task_retries")
+            },
+        }
+
+    async def h_list_named_actors(self, conn, body):
+        return {"names": sorted(self.named_actors)}
+
+    # -- worker death / fault tolerance ---------------------------------------
+
+    async def _handle_worker_death(self, worker_id: WorkerID):
+        worker = self.workers.pop(worker_id, None)
+        if worker is None:
+            return
+        worker.state = DEAD
+        self.node_worker_counts[worker.node_id] = max(
+            0, self.node_worker_counts.get(worker.node_id, 1) - 1
+        )
+        # If this worker hosted an actor that will restart, its creation task
+        # must not seal error objects (the restarted creation reuses them).
+        will_restart_actor = False
+        creation_tid = None
+        if worker.actor_id is not None:
+            actor = self.actors.get(worker.actor_id)
+            if actor is not None and actor.state != "DEAD":
+                creation_tid = TaskID(actor.spec["creation_task"]["task_id"])
+                will_restart_actor = actor.restarts_left != 0
+
+        for tid in list(worker.inflight):
+            task = self.tasks.get(tid)
+            if task is None or task.state != RUNNING:
+                continue
+            if tid == creation_tid and will_restart_actor:
+                continue  # restart path below resubmits this spec
+            # Actor tasks don't hold scheduler resources (the actor does).
+            if not task.spec.get("actor_id") or task.spec.get("is_actor_creation"):
+                self.scheduler.release(task.node_id, task.resources, task.strategy)
+            if task.retries_left != 0 and not task.spec.get("actor_id"):
+                task.retries_left -= 1
+                task.state = PENDING
+                task.worker_id = None
+                self._event("task_retry", task=task.task_id.hex())
+                self.queued_tasks.append(task)
+            else:
+                task.state = FAILED
+                err = serialization.pack(
+                    WorkerCrashedError(
+                        f"worker {worker_id.hex()[:8]} died while running task"
+                    )
+                )
+                for raw in task.spec.get("return_ids", []):
+                    rec = self._obj(ObjectID(raw))
+                    rec.error = err
+                    rec.sealed = True
+                    self._notify_object_ready(rec.object_id)
+                if task.spec.get("num_returns") == "streaming":
+                    self.stream_done.setdefault(task.task_id, 0)
+                    for key, evs in list(self.stream_waiters.items()):
+                        if key[0] == task.task_id.binary():
+                            for ev in self.stream_waiters.pop(key):
+                                ev.set()
+                task.end_time = time.time()
+                self._finalize_task(task)
+
+        if worker.actor_id is not None:
+            actor = self.actors.get(worker.actor_id)
+            if actor is not None and actor.state != "DEAD":
+                # Release the actor's creation resources (unless the creation
+                # task itself was still running — handled in the loop above).
+                ct = self.tasks.get(TaskID(actor.spec["creation_task"]["task_id"]))
+                if ct is not None and ct.node_id is not None and ct.state == FINISHED:
+                    self.scheduler.release(ct.node_id, ct.resources, ct.strategy)
+                if actor.restarts_left != 0:
+                    actor.restarts_left -= 1
+                    actor.state = "RESTARTING"
+                    actor.worker_id = None
+                    await self._publish(
+                        f"actor:{actor.actor_id.hex()}", {"state": "RESTARTING"}
+                    )
+                    # Re-submit the creation task
+                    # (reference: gcs_actor_manager.cc RestartActor).
+                    ct2 = TaskRecord(dict(actor.spec["creation_task"]))
+                    self._register_task(ct2)
+                    if not ct2.pending_deps:
+                        self.queued_tasks.append(ct2)
+                else:
+                    actor.state = "DEAD"
+                    actor.death_cause = "worker process died"
+                    if actor.name:
+                        self.named_actors.pop(actor.name, None)
+                    await self._publish(
+                        f"actor:{actor.actor_id.hex()}", {"state": "DEAD"}
+                    )
+                    await self._fail_actor_queue(actor, None)
+        self._kick()
+
+    # -- placement groups ------------------------------------------------------
+
+    async def h_create_placement_group(self, conn, body):
+        pg_id = PlacementGroupID(body["pg_id"])
+        ok = self.scheduler.create_placement_group(
+            pg_id, body["bundles"], body.get("strategy", "PACK"),
+            body.get("name", ""),
+        )
+        return {"created": ok}
+
+    async def h_remove_placement_group(self, conn, body):
+        self.scheduler.remove_placement_group(PlacementGroupID(body["pg_id"]))
+        return {}
+
+    # -- pubsub (reference: src/ray/pubsub/publisher.h) ------------------------
+
+    async def h_publish(self, conn, body):
+        await self._publish(body["topic"], body["data"])
+        return {}
+
+    async def _publish(self, topic: str, data):
+        for conn_id in list(self.subs.get(topic, ())):
+            c = self.server.connections.get(conn_id)
+            if c is None:
+                self.subs[topic].discard(conn_id)
+                continue
+            try:
+                await c.push("pubsub", {"topic": topic, "data": data})
+            except Exception:
+                pass
+
+    async def h_subscribe(self, conn, body):
+        self.subs.setdefault(body["topic"], set()).add(conn.conn_id)
+        return {}
+
+    # -- introspection ---------------------------------------------------------
+
+    async def h_cluster_resources(self, conn, body):
+        total: Dict[str, float] = {}
+        for n in self.scheduler.nodes.values():
+            for k, v in n.total.items():
+                total[k] = total.get(k, 0.0) + v
+        return {"resources": total}
+
+    async def h_available_resources(self, conn, body):
+        total: Dict[str, float] = {}
+        for n in self.scheduler.nodes.values():
+            for k, v in n.available.items():
+                total[k] = total.get(k, 0.0) + v
+        return {"resources": total}
+
+    async def h_list_state(self, conn, body):
+        kind = body["kind"]
+        if kind == "nodes":
+            return {"items": [
+                {"node_id": nid.hex(), **info}
+                for nid, info in (
+                    (n.node_id, {"resources": n.total, "available": n.available,
+                                 "alive": n.alive, "labels": n.labels})
+                    for n in self.scheduler.nodes.values()
+                )
+            ]}
+        if kind == "actors":
+            return {"items": [
+                {
+                    "actor_id": a.actor_id.hex(),
+                    "class_name": a.spec.get("class_name", ""),
+                    "state": a.state,
+                    "name": a.name,
+                    "pid": (self.workers[a.worker_id].pid
+                            if a.worker_id in self.workers else None),
+                    "num_executed_tasks": a.num_executed,
+                }
+                for a in self.actors.values()
+            ]}
+        if kind == "tasks":
+            live = [
+                {
+                    "task_id": t.task_id.hex(),
+                    "name": t.spec.get("name", ""),
+                    "state": t.state,
+                    "start_time": t.start_time,
+                    "end_time": t.end_time,
+                    "error": t.error,
+                }
+                for t in self.tasks.values()
+                if t.state in (PENDING, RUNNING)  # terminal ones are in the ring
+            ]
+            return {"items": live + list(self.finished_tasks)}
+        if kind == "objects":
+            return {"items": [
+                {
+                    "object_id": o.object_id.hex(),
+                    "size": o.size,
+                    "sealed": o.sealed,
+                    "inline": o.inline is not None,
+                    "ref_count": o.ref_count,
+                }
+                for o in self.objects.values()
+            ]}
+        if kind == "workers":
+            return {"items": [
+                {
+                    "worker_id": w.worker_id.hex(),
+                    "node_id": w.node_id.hex(),
+                    "state": w.state,
+                    "pid": w.pid,
+                }
+                for w in self.workers.values()
+            ]}
+        if kind == "placement_groups":
+            return {"items": list(
+                self.scheduler.snapshot()["placement_groups"].values()
+            )}
+        if kind == "timeline":
+            return {"items": list(self.task_events)}
+        raise ValueError(f"unknown state kind {kind!r}")
+
+    async def h_shutdown_cluster(self, conn, body):
+        asyncio.get_running_loop().call_soon(
+            lambda: asyncio.ensure_future(self.stop())
+        )
+        return {}
+
+
+def env_jax_platform() -> str:
+    # Inherit an explicit JAX_PLATFORMS (tests set cpu); default workers to cpu.
+    return os.environ.get("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,122 @@
+"""ObjectRef: a future handle to a task return or put object.
+
+Reference analog: python/ray/includes/object_ref (Cython ObjectRef) — holds
+the object id, supports get/wait, decrements the reference count on GC so the
+control plane can free the underlying store segment.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from . import serialization
+from .context import ctx
+from .ids import ObjectID
+
+# Batched free queue: ObjectRef.__del__ must never block on RPC.
+_free_lock = threading.Lock()
+_free_queue: list = []
+
+
+def _flush_free_queue():
+    with _free_lock:
+        batch, _free_queue[:] = _free_queue[:], []
+    if batch and ctx.client is not None:
+        try:
+            ctx.client.free_objects(batch)
+        except Exception:
+            pass
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owned", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owned: bool = True):
+        self._id = object_id
+        self._owned = owned
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def object_id(self) -> ObjectID:
+        return self._id
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __del__(self):
+        if self._owned and ctx.client is not None:
+            with _free_lock:
+                _free_queue.append(self._id.binary())
+            if len(_free_queue) >= 100:
+                _flush_free_queue()
+
+    def __reduce__(self):
+        # Crossing a process boundary: the receiver holds a borrowed reference.
+        # The sender bumps the count so the object outlives the transfer
+        # (simplified borrowing vs reference_count.h's full protocol).
+        if ctx.client is not None:
+            ctx.client.add_reference(self._id.binary())
+        return (_reconstruct_ref, (self._id.binary(),))
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, lambda: ctx.client.get([self])[0])
+        return fut.__await__()
+
+
+def _reconstruct_ref(raw: bytes) -> "ObjectRef":
+    return ObjectRef(ObjectID(raw), owned=True)
+
+
+class _TopLevelRef:
+    """Marker for a top-level ObjectRef argument: resolved to its value before
+    the task body runs (Ray semantics: top-level refs are awaited+inlined,
+    nested refs are passed through as refs)."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: bytes):
+        self.raw = raw
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded objects
+    (reference: python/ray/_raylet.pyx ObjectRefGenerator /
+    core_worker.h:392 TryReadObjectRefStream)."""
+
+    def __init__(self, task_id_bytes: bytes):
+        self._task_id = task_id_bytes
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        item = ctx.client.next_stream_item(self._task_id, self._index)
+        if item.get("done"):
+            raise StopIteration
+        if item.get("error") is not None:
+            raise serialization.unpack(item["error"])
+        self._index += 1
+        return ObjectRef(ObjectID(item["object_id"]))
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id,))

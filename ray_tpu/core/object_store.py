@@ -1,0 +1,284 @@
+"""Shared-memory object store (plasma equivalent).
+
+Role-equivalent to the reference's plasma store
+(reference: src/ray/object_manager/plasma/store.h:55 PlasmaStore +
+object_lifecycle_manager.h / eviction_policy.h): immutable sealed objects in
+shared memory, zero-copy reads from any process on the node, LRU eviction with
+spill-to-disk (reference: src/ray/raylet/local_object_manager.h:41 +
+python/ray/_private/external_storage.py FileSystemStorage).
+
+Implementation notes (TPU-first design):
+- Each object is a file under /dev/shm mapped with mmap — no dependence on
+  Python's multiprocessing resource tracker (which unlinks segments that other
+  processes still map).  This mirrors plasma's fd-passing model with the unix
+  permissions model doing the access control.
+- Device arrays never live here: XLA owns TPU HBM.  The store holds host
+  bytes; the TPU edge is `jax.device_put` at consumption time (see
+  ray_tpu.data iterators).
+- A C++ arena (ray_tpu/_native) can replace the per-object-file backend
+  behind the same interface; see ray_tpu/core/native_store.py.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from .ids import ObjectID
+
+_SHM_DIR = "/dev/shm"
+_PREFIX = "rtpu"
+
+
+def _seg_path(session: str, object_id: ObjectID) -> str:
+    return os.path.join(_SHM_DIR, f"{_PREFIX}-{session}-{object_id.hex()}")
+
+
+class _Segment:
+    """A mapped shared-memory segment holding one sealed object."""
+
+    __slots__ = ("path", "size", "mm", "fd")
+
+    def __init__(self, path: str, size: int, create: bool):
+        flags = os.O_RDWR | (os.O_CREAT | os.O_EXCL if create else 0)
+        self.fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(self.fd, size)
+            else:
+                size = os.fstat(self.fd).st_size
+            self.size = size
+            self.mm = mmap.mmap(self.fd, size)
+            self.path = path
+        except Exception:
+            os.close(self.fd)
+            raise
+
+    def view(self) -> memoryview:
+        return memoryview(self.mm)
+
+    def close(self):
+        try:
+            self.mm.close()
+        except (BufferError, ValueError):
+            pass  # outstanding zero-copy views keep the map alive
+        os.close(self.fd)
+
+
+class ObjectStore:
+    """Node-scoped shared-memory object store with LRU eviction + spilling.
+
+    One instance runs inside the node daemon (the accounting owner); worker
+    and driver processes use :class:`StoreClient` views that attach segments
+    read-only by name.
+    """
+
+    def __init__(self, session: str, capacity_bytes: int, spill_dir: str):
+        self._session = session
+        self._capacity = capacity_bytes
+        self._spill_dir = os.path.join(spill_dir, session)
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self._lock = threading.RLock()
+        # Sealed objects in shm, LRU order (oldest first).
+        self._objects: "OrderedDict[ObjectID, _Segment]" = OrderedDict()
+        self._spilled: Dict[ObjectID, str] = {}
+        self._pinned: Dict[ObjectID, int] = {}
+        self._used = 0
+        self.num_evictions = 0
+
+    # -- write path -----------------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        """Allocate a segment for an object; caller writes then calls seal()."""
+        with self._lock:
+            if object_id in self._objects:
+                raise KeyError(f"object {object_id} already exists")
+            self._ensure_capacity(size)
+            seg = _Segment(_seg_path(self._session, object_id), size, create=True)
+            self._objects[object_id] = seg
+            self._used += size
+            return seg.view()
+
+    def seal(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._objects[object_id].size
+
+    def put_blob(self, object_id: ObjectID, blob: bytes) -> int:
+        buf = self.create(object_id, len(blob))
+        buf[:] = blob
+        return self.seal(object_id)
+
+    def adopt(self, object_id: ObjectID) -> int:
+        """Take ownership (accounting + eviction) of a segment that a worker
+        process created directly via StoreClient.create."""
+        with self._lock:
+            if object_id in self._objects:
+                return self._objects[object_id].size
+            seg = _Segment(_seg_path(self._session, object_id), 0, create=False)
+            self._ensure_capacity(seg.size)
+            self._objects[object_id] = seg
+            self._used += seg.size
+            return seg.size
+
+    # -- read path ------------------------------------------------------------
+
+    def get(self, object_id: ObjectID) -> Optional[memoryview]:
+        with self._lock:
+            seg = self._objects.get(object_id)
+            if seg is not None:
+                self._objects.move_to_end(object_id)  # LRU touch
+                return seg.view()
+            if object_id in self._spilled:
+                return self._restore(object_id)
+            return None
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects or object_id in self._spilled
+
+    def pin(self, object_id: ObjectID):
+        with self._lock:
+            self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            n = self._pinned.get(object_id, 0) - 1
+            if n <= 0:
+                self._pinned.pop(object_id, None)
+            else:
+                self._pinned[object_id] = n
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def free(self, object_id: ObjectID):
+        with self._lock:
+            seg = self._objects.pop(object_id, None)
+            if seg is not None:
+                self._used -= seg.size
+                seg.close()
+                try:
+                    os.unlink(seg.path)
+                except FileNotFoundError:
+                    pass
+            spath = self._spilled.pop(object_id, None)
+            if spath is not None:
+                try:
+                    os.unlink(spath)
+                except FileNotFoundError:
+                    pass
+            self._pinned.pop(object_id, None)
+
+    def shutdown(self):
+        with self._lock:
+            for oid in list(self._objects):
+                self.free(oid)
+
+    # -- eviction / spilling --------------------------------------------------
+
+    def _ensure_capacity(self, size: int):
+        if size > self._capacity:
+            raise MemoryError(
+                f"object of {size} bytes exceeds store capacity {self._capacity}"
+            )
+        while self._used + size > self._capacity:
+            victim = next(
+                (oid for oid in self._objects if oid not in self._pinned), None
+            )
+            if victim is None:
+                raise MemoryError(
+                    f"object store full ({self._used} bytes, all pinned)"
+                )
+            self._spill(victim)
+
+    def _spill(self, object_id: ObjectID):
+        seg = self._objects.pop(object_id)
+        path = os.path.join(self._spill_dir, object_id.hex())
+        with open(path, "wb") as f:
+            f.write(seg.view())
+        self._spilled[object_id] = path
+        self._used -= seg.size
+        self.num_evictions += 1
+        seg.close()
+        try:
+            os.unlink(seg.path)
+        except FileNotFoundError:
+            pass
+
+    def _restore(self, object_id: ObjectID) -> memoryview:
+        path = self._spilled.pop(object_id)
+        with open(path, "rb") as f:
+            blob = f.read()
+        os.unlink(path)
+        self._ensure_capacity(len(blob))
+        seg = _Segment(_seg_path(self._session, object_id), len(blob), create=True)
+        seg.view()[:] = blob
+        self._objects[object_id] = seg
+        self._used += len(blob)
+        return seg.view()
+
+    # -- stats ----------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "used_bytes": self._used,
+                "capacity_bytes": self._capacity,
+                "num_objects": len(self._objects),
+                "num_spilled": len(self._spilled),
+                "num_evictions": self.num_evictions,
+            }
+
+
+class StoreClient:
+    """Read/write view of the node's store for worker & driver processes.
+
+    Writers create segments directly (the daemon learns sizes via object
+    registration in the control plane); readers attach by name.  Attached
+    segments are cached so repeated gets are free.
+    """
+
+    def __init__(self, session: str):
+        self._session = session
+        self._attached: Dict[ObjectID, _Segment] = {}
+        self._lock = threading.Lock()
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        seg = _Segment(_seg_path(self._session, object_id), size, create=True)
+        with self._lock:
+            self._attached[object_id] = seg
+        return seg.view()
+
+    def get(self, object_id: ObjectID, timeout: float = 0.0) -> Optional[memoryview]:
+        with self._lock:
+            seg = self._attached.get(object_id)
+            if seg is not None:
+                return seg.view()
+        deadline = time.monotonic() + timeout
+        path = _seg_path(self._session, object_id)
+        while True:
+            try:
+                seg = _Segment(path, 0, create=False)
+                break
+            except FileNotFoundError:
+                if time.monotonic() >= deadline:
+                    return None
+                time.sleep(0.001)
+        with self._lock:
+            self._attached[object_id] = seg
+        return seg.view()
+
+    def detach(self, object_id: ObjectID):
+        with self._lock:
+            seg = self._attached.pop(object_id, None)
+        if seg is not None:
+            seg.close()
+
+    def close(self):
+        with self._lock:
+            for seg in self._attached.values():
+                seg.close()
+            self._attached.clear()

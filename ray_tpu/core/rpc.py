@@ -1,0 +1,297 @@
+"""Message-framed RPC over asyncio TCP sockets.
+
+Role-equivalent to the reference's gRPC layer (reference: src/ray/rpc/
+grpc_server.h, client_call.h): request/response with per-call deadlines plus
+server->client pushes (used for task dispatch and pubsub).  msgpack on the
+wire; protobuf codegen isn't available in this image and the control-plane
+messages are small, so a schema-light encoding is the right trade.
+
+Frame format: [u32 length][msgpack payload]
+Payload: [type, seq, method, body]  with type REQ=0 | RESP=1 | ERR=2 | PUSH=3.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+REQ, RESP, ERR, PUSH = 0, 1, 2, 3
+_HDR = struct.Struct("<I")
+
+
+def _encode(msg) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _HDR.pack(len(body)) + body
+
+
+async def _read_msg(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(_HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    body = await reader.readexactly(n)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class Connection:
+    """One peer connection, server side."""
+
+    _next_id = 0
+
+    def __init__(self, reader, writer, server: "RpcServer"):
+        self.reader = reader
+        self.writer = writer
+        self.server = server
+        Connection._next_id += 1
+        self.conn_id = Connection._next_id
+        self.meta: Dict[str, Any] = {}
+        self.alive = True
+        self._write_lock = asyncio.Lock()
+
+    async def push(self, method: str, body: Any):
+        async with self._write_lock:
+            self.writer.write(_encode([PUSH, 0, method, body]))
+            await self.writer.drain()
+
+    async def _send(self, msg):
+        async with self._write_lock:
+            self.writer.write(_encode(msg))
+            await self.writer.drain()
+
+
+class RpcServer:
+    """Asyncio RPC server.  Handlers are ``async def handler(conn, body)`` or
+    plain callables; return value becomes the response body."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.handlers: Dict[str, Callable[[Connection, Any], Awaitable[Any]]] = {}
+        self.connections: Dict[int, Connection] = {}
+        self.on_disconnect: Optional[Callable[[Connection], Awaitable[None]]] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def handler(self, name: str):
+        def deco(fn):
+            self.handlers[name] = fn
+            return fn
+
+        return deco
+
+    def register(self, name: str, fn):
+        self.handlers[name] = fn
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        # Close live connections first: in py3.12+ wait_closed() waits for all
+        # connection handlers, which would deadlock while clients are attached.
+        for conn in list(self.connections.values()):
+            conn.writer.close()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self)
+        self.connections[conn.conn_id] = conn
+        try:
+            while True:
+                mtype, seq, method, body = await _read_msg(reader)
+                if mtype == REQ:
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(conn, seq, method, body)
+                    )
+                # Servers ignore stray RESP/PUSH frames.
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            conn.alive = False
+            self.connections.pop(conn.conn_id, None)
+            writer.close()
+            if self.on_disconnect is not None:
+                await self.on_disconnect(conn)
+
+    async def _dispatch(self, conn, seq, method, body):
+        try:
+            fn = self.handlers.get(method)
+            if fn is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = fn(conn, body)
+            if asyncio.iscoroutine(result):
+                result = await result
+            await conn._send([RESP, seq, method, result])
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            tb = traceback.format_exc()
+            try:
+                await conn._send([ERR, seq, method, f"{e}\n{tb}"])
+            except Exception:
+                pass
+
+
+class RpcClient:
+    """Thread-safe synchronous client over a background asyncio loop.
+
+    Push handlers run on the loop; long handlers must hand off to a thread.
+    """
+
+    def __init__(self, host: str, port: int, name: str = "rpc-client"):
+        self.host = host
+        self.port = port
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name=name, daemon=True
+        )
+        self._thread.start()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._push_handlers: Dict[str, Callable[[Any], None]] = {}
+        self._writer = None
+        self._write_lock = None
+        self._reader_task = None
+        self.closed = False
+        self.on_connection_lost: Optional[Callable[[], None]] = None
+        fut = asyncio.run_coroutine_threadsafe(self._connect(), self._loop)
+        fut.result(timeout=30)
+
+    async def _connect(self):
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self._write_lock = asyncio.Lock()
+        self._reader_task = asyncio.get_running_loop().create_task(
+            self._read_loop()
+        )
+
+    async def _read_loop(self):
+        try:
+            while True:
+                mtype, seq, method, body = await _read_msg(self._reader)
+                if mtype in (RESP, ERR):
+                    fut = self._pending.pop(seq, None)
+                    if fut is not None and not fut.done():
+                        if mtype == RESP:
+                            fut.set_result(body)
+                        else:
+                            fut.set_exception(RpcError(body))
+                elif mtype == PUSH:
+                    fn = self._push_handlers.get(method)
+                    if fn is not None:
+                        try:
+                            fn(body)
+                        except Exception:
+                            traceback.print_exc()
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost("connection lost"))
+            self._pending.clear()
+            if self.on_connection_lost is not None:
+                try:
+                    self.on_connection_lost()
+                except Exception:
+                    traceback.print_exc()
+
+    def on_push(self, method: str, handler: Callable[[Any], None]):
+        self._push_handlers[method] = handler
+
+    async def _send_request(self, seq, method, body):
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        async with self._write_lock:
+            self._writer.write(_encode([REQ, seq, method, body]))
+            await self._writer.drain()
+        return await fut
+
+    def call(self, method: str, body: Any = None, timeout: float = 60.0) -> Any:
+        if self.closed:
+            raise ConnectionLost("client is closed")
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        fut = asyncio.run_coroutine_threadsafe(
+            self._send_request(seq, method, body), self._loop
+        )
+        return fut.result(timeout=timeout)
+
+    def call_async(self, method: str, body: Any = None):
+        """Fire a request, return a concurrent.futures.Future."""
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        return asyncio.run_coroutine_threadsafe(
+            self._send_request(seq, method, body), self._loop
+        )
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+
+        def _shutdown():
+            if self._reader_task is not None:
+                self._reader_task.cancel()
+            if self._writer is not None:
+                self._writer.close()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=5)
+
+
+class ServerThread:
+    """Runs an RpcServer (plus arbitrary coroutines) on a dedicated thread."""
+
+    def __init__(self, server: RpcServer):
+        self.server = server
+        self.loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True, name="rpc-server")
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_until_complete(self.server.start())
+        self._started.set()
+        self.loop.run_forever()
+
+    def start(self) -> int:
+        self.thread.start()
+        self._started.wait(timeout=30)
+        return self.server.port
+
+    def run_coro(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        async def _stop():
+            await self.server.stop()
+            self.loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_stop(), self.loop)
+            self.thread.join(timeout=5)
+        except Exception:
+            pass

@@ -1,0 +1,121 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Role-equivalent to the reference's SerializationContext
+(reference: python/ray/_private/serialization.py:114) — cloudpickle for
+arbitrary Python objects, protocol-5 ``buffer_callback`` so large contiguous
+buffers (numpy / jax host arrays, Arrow buffers) are carried out-of-band and
+can be placed directly into shared memory with zero copies on the write path.
+
+Wire format of a sealed object:
+    [u32 meta_len][meta pickle bytes][u64 nbuf]
+    ([u64 buf_len][buf bytes]) * nbuf
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable, Dict, List, Tuple
+
+import cloudpickle
+
+# Registry of custom reducers installed by the runtime (ObjectRef, ActorHandle).
+_custom_reducers: Dict[type, Callable] = {}
+
+
+def register_reducer(cls: type, reducer: Callable) -> None:
+    _custom_reducers[cls] = reducer
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def reducer_override(self, obj):
+        fn = _custom_reducers.get(type(obj))
+        if fn is not None:
+            return fn(obj)
+        return super().reducer_override(obj)
+
+
+def _to_host(obj: Any) -> Any:
+    """Device arrays cross process boundaries as host numpy arrays."""
+    import sys
+
+    jax = sys.modules.get("jax")  # never import jax just to type-check
+    if jax is not None and isinstance(obj, jax.Array):
+        import numpy as np
+
+        return np.asarray(obj)
+    return obj
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Serialize to (meta, out-of-band buffers)."""
+    import io
+
+    obj = _to_host(obj)
+    buffers: List[pickle.PickleBuffer] = []
+    bio = io.BytesIO()
+    pickler = _Pickler(bio, protocol=5, buffer_callback=buffers.append)
+    pickler.dump(obj)
+    return bio.getvalue(), buffers
+
+
+def deserialize(meta: bytes, buffers: List[Any]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def pack(obj: Any) -> bytes:
+    """Serialize to a single contiguous blob (header + meta + buffers)."""
+    meta, buffers = serialize(obj)
+    parts = [struct.pack("<I", len(meta)), meta, struct.pack("<Q", len(buffers))]
+    for b in buffers:
+        raw = b.raw()
+        parts.append(struct.pack("<Q", raw.nbytes))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def packed_size(meta: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    n = 4 + len(meta) + 8
+    for b in buffers:
+        n += 8 + b.raw().nbytes
+    return n
+
+
+def pack_into(meta: bytes, buffers: List[pickle.PickleBuffer], dest: memoryview) -> int:
+    """Write the packed representation directly into ``dest`` (e.g. a shm
+    segment), returning bytes written.  This is the zero-extra-copy write path."""
+    off = 0
+    dest[off : off + 4] = struct.pack("<I", len(meta))
+    off += 4
+    dest[off : off + len(meta)] = meta
+    off += len(meta)
+    dest[off : off + 8] = struct.pack("<Q", len(buffers))
+    off += 8
+    for b in buffers:
+        raw = b.raw()
+        n = raw.nbytes
+        dest[off : off + 8] = struct.pack("<Q", n)
+        off += 8
+        dest[off : off + n] = raw.cast("B") if raw.format != "B" else raw
+        off += n
+    return off
+
+
+def unpack(blob: memoryview | bytes) -> Any:
+    """Deserialize from a packed blob.  Buffer contents are NOT copied — numpy
+    arrays deserialized from shm alias the segment until the caller copies."""
+    view = memoryview(blob)
+    off = 0
+    (meta_len,) = struct.unpack_from("<I", view, off)
+    off += 4
+    meta = bytes(view[off : off + meta_len])
+    off += meta_len
+    (nbuf,) = struct.unpack_from("<Q", view, off)
+    off += 8
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = struct.unpack_from("<Q", view, off)
+        off += 8
+        buffers.append(view[off : off + blen])
+        off += blen
+    return deserialize(meta, buffers)

@@ -1,0 +1,326 @@
+"""Worker process: executes tasks and hosts actor instances.
+
+Role-equivalent to the reference's worker-side core worker
+(reference: src/ray/core_worker/core_worker.h:350 RunTaskExecutionLoop,
+transport/task_receiver.h, concurrency_group_manager.h for actor
+concurrency, _raylet.pyx:1693 execute_task) — re-designed: tasks arrive as
+pushes over one ordered connection from the control plane (which gives
+per-actor FIFO for free), execution happens on a thread pool (or an asyncio
+loop for async actors), results go inline or to node shared memory.
+
+Workers are spawned with JAX_PLATFORMS=cpu by default so they never steal the
+TPU from the SPMD job that owns it; a task opts into the chip by requesting
+{"TPU": n} resources, which the spawner translates into TPU visibility env
+vars (the reference does the same dance with TPU_VISIBLE_CHIPS at
+python/ray/_private/accelerators/tpu.py:155).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import inspect
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from .. import exceptions
+from . import serialization
+from .client import Client
+from .config import get_config
+from .context import ctx
+from .ids import ActorID, ObjectID, TaskID
+from .object_ref import ObjectRef, _TopLevelRef
+
+
+class Worker:
+    def __init__(self):
+        self.head_addr = os.environ["RT_HEAD_ADDR"]
+        self.node_id = bytes.fromhex(os.environ["RT_NODE_ID"])
+        self.worker_id = os.urandom(16)
+        self.client = Client(
+            self.head_addr,
+            kind="worker",
+            worker_id=self.worker_id,
+            node_id=self.node_id,
+            pid=os.getpid(),
+        )
+        ctx.client = self.client
+        ctx.mode = "worker"
+        ctx.session = self.client.session
+        ctx.worker_id = self.worker_id
+
+        self.task_queue: "queue.Queue" = queue.Queue()
+        self.fn_cache: Dict[str, Any] = {}
+        self.actor_instance = None
+        self.actor_id: Optional[bytes] = None
+        self.max_concurrency = 1
+        self.pool: Optional[ThreadPoolExecutor] = None
+        self.async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self.running_threads: Dict[bytes, int] = {}  # task_id -> thread ident
+        self.cancelled: set = set()
+        self._shutdown = threading.Event()
+
+        self.client.rpc.on_push("execute_task", self.task_queue.put)
+        self.client.rpc.on_push("cancel", self._on_cancel)
+        self.client.rpc.on_push("shutdown", lambda b: self._shutdown.set())
+        self.client.rpc.on_push("exit", lambda b: os._exit(1))
+        self.client.rpc.on_connection_lost = lambda: os._exit(0)
+        # Handshake: only now may the head lease us (push handlers installed).
+        self.client.call("worker_ready", {})
+
+    # ---------------------------------------------------------------- loading
+
+    def _load(self, key: str):
+        obj = self.fn_cache.get(key)
+        if obj is None:
+            blob = self.client.kv_get(key)
+            if blob is None:
+                raise RuntimeError(f"function table has no entry {key}")
+            obj = cloudpickle.loads(blob)
+            self.fn_cache[key] = obj
+        return obj
+
+    def _resolve_args(self, spec) -> tuple:
+        if spec.get("args_ref") is not None:
+            oid = ObjectID(spec["args_ref"])
+            desc = self.client.get_raw([oid])[0]
+            args, kwargs = self.client._materialize(oid, desc)
+        else:
+            args, kwargs = serialization.unpack(spec["args"])
+        # Resolve top-level refs to values.
+        fetch = [a.raw for a in args if isinstance(a, _TopLevelRef)]
+        fetch += [v.raw for v in kwargs.values() if isinstance(v, _TopLevelRef)]
+        if fetch:
+            refs = [ObjectRef(ObjectID(raw), owned=False) for raw in fetch]
+            values = dict(zip(fetch, self.client.get(refs)))
+            args = tuple(
+                values[a.raw] if isinstance(a, _TopLevelRef) else a for a in args
+            )
+            kwargs = {
+                k: values[v.raw] if isinstance(v, _TopLevelRef) else v
+                for k, v in kwargs.items()
+            }
+        return args, kwargs
+
+    # -------------------------------------------------------------- reporting
+
+    def _store_value(self, oid: ObjectID, value) -> dict:
+        cfg = get_config()
+        meta, buffers = serialization.serialize(value)
+        size = serialization.packed_size(meta, buffers)
+        if size <= cfg.inline_object_max_bytes:
+            blob = bytearray(size)
+            serialization.pack_into(meta, buffers, memoryview(blob))
+            return {"object_id": oid.binary(), "inline": bytes(blob)}
+        buf = self.client.store().create(oid, size)
+        serialization.pack_into(meta, buffers, buf)
+        return {"object_id": oid.binary(), "size": size}
+
+    def _report_done(self, spec, returns=None, error=None, retryable=False,
+                     error_repr="", stream_count=0):
+        body = {
+            "task_id": spec["task_id"],
+            "returns": returns or [],
+            "stream_count": stream_count,
+        }
+        if error is not None:
+            body["error"] = error
+            body["retryable"] = retryable
+            body["error_repr"] = error_repr
+            body["returns"] = [
+                {"object_id": raw} for raw in spec.get("return_ids", [])
+            ]
+        try:
+            self.client.call("task_done", body)
+        except Exception:
+            os._exit(1)
+
+    # -------------------------------------------------------------- execution
+
+    def _execute(self, spec):
+        task_id = spec["task_id"]
+        ctx.current_task_id = TaskID(task_id)
+        self.running_threads[task_id] = threading.get_ident()
+        saved_env: Dict[str, Optional[str]] = {}
+        try:
+            if task_id in self.cancelled:
+                raise exceptions.TaskCancelledError(TaskID(task_id).hex())
+            renv = spec.get("runtime_env") or {}
+            env_vars = renv.get("env_vars") or {}
+            saved_env = {k: os.environ.get(k) for k in env_vars}
+            for k, v in env_vars.items():
+                os.environ[k] = v
+
+            if spec.get("is_actor_creation"):
+                cls = self._load(spec["func_key"])
+                args, kwargs = self._resolve_args(spec)
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_id = spec["actor_id"]
+                ctx.current_actor_id = ActorID(self.actor_id)
+                self.max_concurrency = spec.get("max_concurrency", 1)
+                if self.max_concurrency > 1:
+                    self.pool = ThreadPoolExecutor(self.max_concurrency)
+                self._report_done(
+                    spec,
+                    returns=[self._store_value(
+                        ObjectID(spec["return_ids"][0]), None)],
+                )
+                return
+
+            if spec.get("method_name"):
+                fn = getattr(self.actor_instance, spec["method_name"])
+            else:
+                fn = self._load(spec["func_key"])
+            args, kwargs = self._resolve_args(spec)
+
+            if inspect.iscoroutinefunction(
+                fn.__func__ if inspect.ismethod(fn) else fn
+            ):
+                self._execute_async(spec, fn, args, kwargs)
+                return
+
+            result = fn(*args, **kwargs)
+
+            if spec.get("num_returns") == "streaming":
+                count = 0
+                for item in result:
+                    oid = ObjectID.for_task_return(TaskID(task_id), count + 1000)
+                    info = self._store_value(oid, item)
+                    self.client.call(
+                        "stream_item",
+                        {"task_id": task_id, "index": count, **info},
+                    )
+                    count += 1
+                self._report_done(spec, returns=[], stream_count=count)
+                return
+
+            self._finish_ok(spec, result)
+        except BaseException as e:  # noqa: BLE001 — all errors cross the wire
+            self._finish_err(spec, e)
+        finally:
+            # Actor processes keep their runtime_env; pooled task workers
+            # restore so env vars don't leak into unrelated tasks.
+            if self.actor_instance is None:
+                for k, old in saved_env.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+            self.running_threads.pop(task_id, None)
+            ctx.current_task_id = None
+
+    def _finish_ok(self, spec, result):
+        num_returns = spec.get("num_returns", 1)
+        return_ids = spec.get("return_ids", [])
+        if num_returns == 1 or len(return_ids) == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != len(return_ids):
+                raise ValueError(
+                    f"task declared {len(return_ids)} returns but produced "
+                    f"{len(values)}"
+                )
+        returns = [
+            self._store_value(ObjectID(raw), v)
+            for raw, v in zip(return_ids, values)
+        ]
+        self._report_done(spec, returns=returns)
+
+    def _finish_err(self, spec, e: BaseException):
+        tb = traceback.format_exc()
+        if isinstance(e, exceptions.RayTpuError):
+            wrapped = e
+        else:
+            wrapped = exceptions.TaskError(e, tb)
+        try:
+            blob = serialization.pack(wrapped)
+        except Exception:
+            blob = serialization.pack(
+                exceptions.TaskError(RuntimeError(repr(e)), tb)
+            )
+        retryable = bool(spec.get("retry_exceptions")) and not isinstance(
+            e, exceptions.TaskCancelledError
+        )
+        self._report_done(
+            spec, error=blob, retryable=retryable, error_repr=repr(e)
+        )
+
+    def _execute_async(self, spec, fn, args, kwargs):
+        """Async actor method: run as a coroutine on the actor's event loop,
+        concurrently with other async methods (reference: fiber.h /
+        actor_scheduling_queue async mode)."""
+        if self.async_loop is None:
+            self.async_loop = asyncio.new_event_loop()
+            threading.Thread(
+                target=self.async_loop.run_forever, daemon=True,
+                name="actor-async-loop",
+            ).start()
+
+        async def run():
+            try:
+                result = await fn(*args, **kwargs)
+                self._finish_ok(spec, result)
+            except BaseException as e:  # noqa: BLE001
+                self._finish_err(spec, e)
+
+        asyncio.run_coroutine_threadsafe(run(), self.async_loop)
+
+    # ------------------------------------------------------------ cancellation
+
+    def _on_cancel(self, body):
+        task_id = body["task_id"]
+        self.cancelled.add(task_id)
+        if body.get("force"):
+            os._exit(1)
+        ident = self.running_threads.get(task_id)
+        if ident is not None:
+            # Raise TaskCancelledError inside the executing thread (same
+            # mechanism as the reference's cancellation handler in
+            # _raylet.pyx execute_task_with_cancellation_handler).
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(ident),
+                ctypes.py_object(exceptions.TaskCancelledError),
+            )
+
+    # ------------------------------------------------------------------- loop
+
+    def run(self):
+        while not self._shutdown.is_set():
+            try:
+                spec = self.task_queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            is_method = bool(spec.get("method_name"))
+            fn = getattr(self.actor_instance, spec["method_name"], None) \
+                if is_method and self.actor_instance is not None else None
+            is_async = fn is not None and inspect.iscoroutinefunction(
+                fn.__func__ if inspect.ismethod(fn) else fn
+            )
+            if self.pool is not None and is_method and not is_async:
+                self.pool.submit(self._execute, spec)
+            else:
+                # Async methods dispatch to the actor loop from here without
+                # blocking, preserving queue order for sync methods.
+                self._execute(spec)
+        os._exit(0)
+
+
+def main():
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps all stacks
+    worker = Worker()
+    worker.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+"""Public exception types (role-equivalent of python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray_tpu.get() with the remote
+    traceback attached (reference: python/ray/exceptions.py RayTaskError)."""
+
+    def __init__(self, cause: BaseException, remote_traceback: str = ""):
+        super().__init__(
+            f"task raised {type(cause).__name__}: {cause}\n"
+            f"--- remote traceback ---\n{remote_traceback}"
+        )
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+
+    def __reduce__(self):
+        # Default Exception reduce would re-init with the formatted message
+        # string as `cause`, double-wrapping on unpickle.
+        return (type(self), (self.cause, self.remote_traceback))
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id_hex: str, cause: str = ""):
+        super().__init__(f"actor {actor_id_hex[:12]} died: {cause}")
+        self.actor_id_hex = actor_id_hex
+        self.cause = cause
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass

@@ -1,0 +1,231 @@
+"""End-to-end actor API tests: creation, method ordering, named actors,
+restarts, async actors, max_concurrency, kill, handle passing.
+
+Models the reference's python/ray/tests/test_actor.py coverage.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@pytest.fixture(scope="module")
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(rt):
+    c = Counter.remote()
+    assert rt.get(c.inc.remote()) == 1
+    assert rt.get(c.inc.remote(5)) == 6
+    assert rt.get(c.read.remote()) == 6
+
+
+def test_actor_init_args(rt):
+    c = Counter.remote(100)
+    assert rt.get(c.read.remote()) == 100
+
+
+def test_actor_method_ordering(rt):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    assert rt.get(refs[-1]) == 50  # strict FIFO per actor
+    assert rt.get(refs) == list(range(1, 51))
+
+
+def test_actor_error(rt):
+    @rt.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(exceptions.TaskError, match="actor method failed"):
+        rt.get(b.fail.remote())
+    # Actor survives method errors.
+    assert rt.get(b.ok.remote()) == "fine"
+
+
+def test_named_actor(rt):
+    c = Counter.options(name="global_counter").remote()
+    rt.get(c.inc.remote())
+    c2 = rt.get_actor("global_counter")
+    assert rt.get(c2.read.remote()) == 1
+    assert "global_counter" in rt.list_named_actors()
+
+
+def test_actor_handle_passing(rt):
+    c = Counter.remote()
+
+    @rt.remote
+    def bump(handle):
+        return ray_tpu.get(handle.inc.remote())
+
+    assert rt.get(bump.remote(c)) == 1
+    assert rt.get(c.read.remote()) == 1
+
+
+def test_kill_actor(rt):
+    c = Counter.remote()
+    rt.get(c.inc.remote())
+    rt.kill(c)
+    time.sleep(0.3)
+    with pytest.raises((exceptions.ActorDiedError, exceptions.WorkerCrashedError)):
+        rt.get(c.inc.remote())
+
+
+def test_actor_restart(rt):
+    @rt.remote(max_restarts=1)
+    class Crasher:
+        def __init__(self):
+            self.n = 0
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            self.n += 1
+            return self.n
+
+    a = Crasher.remote()
+    assert rt.get(a.ping.remote()) == 1
+    try:
+        rt.get(a.crash.remote())
+    except Exception:
+        pass
+    # Restarted with fresh state.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            assert rt.get(a.ping.remote(), timeout=10) == 1
+            break
+        except (exceptions.ActorDiedError, exceptions.WorkerCrashedError):
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_async_actor(rt):
+    @rt.remote
+    class AsyncActor:
+        async def slow(self, t, v):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return v
+
+    a = AsyncActor.remote()
+    rt.get(a.slow.remote(0.0, -1))  # wait until the actor is up
+    start = time.monotonic()
+    refs = [a.slow.remote(0.5, i) for i in range(4)]
+    assert rt.get(refs) == [0, 1, 2, 3]
+    # Concurrent execution: total << 4 * 0.5s.
+    assert time.monotonic() - start < 1.5
+
+
+def test_max_concurrency(rt):
+    @rt.remote(max_concurrency=4)
+    class Threaded:
+        def slow(self):
+            time.sleep(0.5)
+            return 1
+
+    a = Threaded.remote()
+    rt.get(a.slow.remote())  # wait until the actor is up
+    start = time.monotonic()
+    assert sum(rt.get([a.slow.remote() for _ in range(4)])) == 4
+    assert time.monotonic() - start < 1.5
+
+
+def test_actor_streaming_method(rt):
+    @rt.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    a = Gen.remote()
+    gen = a.stream.options(num_returns="streaming").remote(4)
+    assert [rt.get(r) for r in gen] == [0, 1, 2, 3]
+
+
+def test_actor_creation_failure(rt):
+    @rt.remote
+    class BadInit:
+        def __init__(self):
+            raise ValueError("init failed")
+
+        def ping(self):
+            return 1
+
+    a = BadInit.remote()
+    with pytest.raises(exceptions.TaskError, match="init failed"):
+        rt.get(a.ping.remote())
+
+
+def test_state_api(rt):
+    from ray_tpu.core.context import ctx
+
+    c = Counter.remote()
+    rt.get(c.read.remote())
+    actors = ctx.client.call("list_state", {"kind": "actors"})["items"]
+    assert any(a["class_name"] == "Counter" for a in actors)
+    workers = ctx.client.call("list_state", {"kind": "workers"})["items"]
+    assert len(workers) >= 1
+
+
+def test_actor_task_with_pending_dep(rt):
+    """An actor method whose arg is an unfinished task output must still run
+    on the actor (regression: dep-blocked actor tasks once leaked to plain
+    task workers)."""
+
+    @rt.remote
+    def slow_value():
+        time.sleep(0.3)
+        return 7
+
+    c = Counter.remote()
+    ref = c.inc.remote(slow_value.remote())
+    assert rt.get(ref, timeout=15) == 7
+    assert rt.get(c.read.remote()) == 7
+
+
+def test_many_zero_cpu_actors(rt):
+    """More actors than CPUs: actors reserve no CPU by default."""
+    actors = [Counter.remote() for _ in range(10)]  # > 6 CPUs
+    assert rt.get([a.inc.remote() for a in actors], timeout=60) == [1] * 10
+
+
+def test_resources_not_inflated_by_actor_calls(rt):
+    """Regression: actor method completions once released CPU never acquired."""
+    c = Counter.remote()
+    rt.get([c.inc.remote() for _ in range(20)])
+    time.sleep(0.2)
+    avail = rt.available_resources()
+    total = rt.cluster_resources()
+    assert avail["CPU"] <= total["CPU"] + 1e-6
