@@ -1,0 +1,135 @@
+"""Benchmark: Llama train-step MFU on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+value = model FLOPs utilization (%) of a full forward+backward+optimizer
+train step of the ~1.3B-param Llama config (bf16, remat, Pallas flash
+attention).  vs_baseline = MFU / 50% — the north-star target from
+BASELINE.json ("≥50% MFU ... zero GPUs"); the reference has no TPU numbers
+(BASELINE.json.published == {}).
+
+MFU convention: required model FLOPs only (6N per token + causal attention
+6·L·S·d), rematerialization excluded — the standard PaLM-style accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+PEAK_FLOPS = {
+    # bf16 peak per chip
+    "v5 lite": 197e12,   # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12,   # trillium
+    "cpu": 1e12,         # nominal, for smoke runs only
+}
+
+
+def _peak_flops() -> float:
+    if jax.default_backend() != "tpu":
+        return PEAK_FLOPS["cpu"]
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def _run(batch: int, seq: int, steps: int, cfg) -> dict:
+    from ray_tpu.models import TrainState, llama_init, llama_loss
+    from ray_tpu.models.train_state import default_optimizer, make_train_step
+
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tx = default_optimizer(lr=1e-4, grad_clip=1.0)
+    state = TrainState.create(params, tx)
+    step = make_train_step(
+        lambda p, b: llama_loss(cfg, p, b["tokens"], b["targets"]), tx
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size
+    )
+    batch_d = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+    # Compile + warmup.  NOTE: sync via host transfer (float()), not
+    # block_until_ready — remote-tunnel TPU backends treat the latter as a
+    # no-op, which silently breaks timing.
+    state, m = step(state, batch_d)
+    float(m["loss"])
+    state, m = step(state, batch_d)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch_d)
+    final_loss = float(m["loss"])  # forces the whole dependent chain
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * seq * cfg.d_model
+    mfu = tokens_per_sec * flops_per_token / _peak_flops()
+    return {
+        "n_params": n_params,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_time_s": round(dt / steps, 4),
+        "mfu": mfu,
+        "loss": final_loss,
+    }
+
+
+def main():
+    from ray_tpu.models import LlamaConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig.b1(remat=True, dtype=jnp.bfloat16, max_seq=2048)
+        plan = [(8, 2048, 10), (4, 2048, 10), (2, 2048, 10), (1, 1024, 10)]
+    else:
+        cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+        plan = [(2, 128, 3)]
+
+    result = None
+    for batch, seq, steps in plan:
+        try:
+            result = _run(batch, seq, steps, cfg)
+            result["batch"] = batch
+            result["seq"] = seq
+            break
+        except Exception as e:  # OOM etc: retry smaller
+            print(f"# bench config ({batch}x{seq}) failed: {e}",
+                  file=sys.stderr)
+    if result is None:
+        print(json.dumps({
+            "metric": "llama_train_mfu", "value": 0.0, "unit": "%MFU",
+            "vs_baseline": 0.0, "error": "all configs failed",
+        }))
+        return 1
+
+    mfu_pct = result["mfu"] * 100
+    print(json.dumps({
+        "metric": "llama_1b3_train_mfu_single_chip" if on_tpu
+                  else "llama_tiny_train_smoke_cpu",
+        "value": round(mfu_pct, 2),
+        "unit": "%MFU",
+        "vs_baseline": round(result["mfu"] / 0.50, 4),
+        "device": str(jax.devices()[0].device_kind),
+        "tokens_per_sec": result["tokens_per_sec"],
+        "step_time_s": result["step_time_s"],
+        "n_params": result["n_params"],
+        "batch": result["batch"],
+        "seq": result["seq"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Collective communication groups (reference: python/ray/util/collective/).
+
+Two planes, reflecting the TPU reality:
+
+- **Device plane** (the NCCL replacement): collectives happen *inside*
+  compiled XLA programs over ICI/DCN — `psum`/`all_gather`/`ppermute` under
+  pjit/shard_map.  `xla_ops` provides thin named-axis wrappers so library
+  code reads like the reference's collective API.
+
+- **Host plane** (the Gloo replacement): named groups of framework
+  workers/actors exchanging host numpy arrays through the cluster KV +
+  object store — rendezvous and small-tensor control traffic
+  (reference: util/collective/collective_group/gloo_collective_group.py:66
+  uses Ray's KV the same way).
+"""
+
+from .collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_world_size,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from . import xla_ops
+
+__all__ = [
+    "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "allreduce", "allgather", "reducescatter",
+    "broadcast", "barrier", "send", "recv", "get_rank", "get_world_size",
+    "xla_ops",
+]

@@ -1,0 +1,198 @@
+"""Host-plane collective groups over the cluster KV.
+
+API-compatible role with the reference's collective library
+(reference: util/collective/collective.py:120 init_collective_group,
+:258 allreduce, :298 barrier, :373 broadcast, :423 allgather,
+:472 reducescatter, :531/:594 send/recv).  The backend is the control
+plane's KV store (the same role Ray's internal KV plays for the pygloo
+rendezvous — gloo_collective_group.py:66); payloads are host numpy arrays.
+
+Intended for *control-plane sized* data: rendezvous, metric reduction, small
+weight broadcast.  Bulk tensor traffic belongs on the device plane
+(collective.xla_ops inside pjit/shard_map) where it rides ICI.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_groups: Dict[str, "GroupState"] = {}
+_POLL_S = 0.002
+
+
+class GroupState:
+    def __init__(self, world_size: int, rank: int, name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.name = name
+        # Per-tag op counters: collectives stay aligned because every rank
+        # calls the same collectives in the same order; p2p counters are
+        # per (src, dst, tag) so asymmetric send/recv patterns can't
+        # desynchronize the rendezvous keys.
+        self.seqs: Dict[str, int] = {}
+
+    def next_seq(self, tag: str) -> int:
+        self.seqs[tag] = self.seqs.get(tag, 0) + 1
+        return self.seqs[tag]
+
+
+def _client():
+    from ..core.context import ctx
+
+    if ctx.client is None:
+        raise RuntimeError("collective ops need an initialized cluster "
+                           "(call ray_tpu.init() / run inside a worker)")
+    return ctx.client
+
+
+def _group(name: str) -> GroupState:
+    g = _groups.get(name)
+    if g is None:
+        raise ValueError(f"collective group {name!r} not initialized here")
+    return g
+
+
+def init_collective_group(
+    world_size: int, rank: int, *, group_name: str = "default", backend: str = "kv"
+) -> None:
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    _groups[group_name] = GroupState(world_size, rank, group_name)
+    barrier(group_name)  # rendezvous: everyone must arrive
+
+
+def create_collective_group(
+    actors: List[Any], world_size: int, ranks: List[int],
+    *, group_name: str = "default",
+) -> None:
+    """Declarative variant: install the group on a list of actor handles
+    (each actor must expose `_init_collective(world, rank, name)` or be a
+    framework-managed worker)."""
+    import ray_tpu
+
+    refs = [
+        a._init_collective.remote(world_size, r, group_name)
+        for a, r in zip(actors, ranks)
+    ]
+    ray_tpu.get(refs)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups.pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_world_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+# ---------------------------------------------------------------- internals
+
+
+def _post(key: str, value) -> None:
+    _client().kv_put(key, pickle.dumps(value, protocol=5))
+
+
+def _wait_key(key: str, timeout: float) -> Any:
+    c = _client()
+    deadline = time.monotonic() + timeout
+    while True:
+        raw = c.kv_get(key)
+        if raw is not None:
+            return pickle.loads(raw)
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"collective op timed out waiting for {key}")
+        time.sleep(_POLL_S)
+
+
+def _gather_all(g: GroupState, tag: str, value, timeout: float) -> List[Any]:
+    seq = g.next_seq(tag)
+    base = f"col:{g.name}:{tag}:{seq}"
+    _post(f"{base}:{g.rank}", value)
+    out = [
+        _wait_key(f"{base}:{r}", timeout) if r != g.rank else value
+        for r in range(g.world_size)
+    ]
+    # Lazy cleanup: delete our rank's key from two ops ago (everyone has
+    # certainly consumed it — op N+1 acted as a barrier).
+    if seq > 2:
+        _client().kv_del(f"col:{g.name}:{tag}:{seq - 2}:{g.rank}")
+    return out
+
+
+# --------------------------------------------------------------------- ops
+
+
+def allreduce(tensor: np.ndarray, *, group_name: str = "default",
+              op: str = "sum", timeout: float = 60.0) -> np.ndarray:
+    g = _group(group_name)
+    parts = _gather_all(g, "ar", np.asarray(tensor), timeout)
+    stack = np.stack(parts)
+    if op == "sum":
+        return stack.sum(axis=0)
+    if op == "mean":
+        return stack.mean(axis=0)
+    if op == "max":
+        return stack.max(axis=0)
+    if op == "min":
+        return stack.min(axis=0)
+    raise ValueError(f"unsupported op {op!r}")
+
+
+def allgather(tensor: np.ndarray, *, group_name: str = "default",
+              timeout: float = 60.0) -> List[np.ndarray]:
+    g = _group(group_name)
+    return [np.asarray(t) for t in
+            _gather_all(g, "ag", np.asarray(tensor), timeout)]
+
+
+def reducescatter(tensor: np.ndarray, *, group_name: str = "default",
+                  op: str = "sum", timeout: float = 60.0) -> np.ndarray:
+    g = _group(group_name)
+    reduced = allreduce(tensor, group_name=group_name, op=op, timeout=timeout)
+    chunks = np.array_split(reduced, g.world_size, axis=0)
+    return chunks[g.rank]
+
+
+def broadcast(tensor: Optional[np.ndarray], *, group_name: str = "default",
+              root: int = 0, timeout: float = 60.0) -> np.ndarray:
+    g = _group(group_name)
+    seq = g.next_seq(f"bc{root}")
+    key = f"col:{g.name}:bc{root}:{seq}"
+    if g.rank == root:
+        _post(key, np.asarray(tensor))
+        if seq > 2:  # lazy cleanup of an op every rank has long consumed
+            _client().kv_del(f"col:{g.name}:bc{root}:{seq - 2}")
+        return np.asarray(tensor)
+    return np.asarray(_wait_key(key, timeout))
+
+
+def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
+    g = _group(group_name)
+    _gather_all(g, "bar", g.rank, timeout)
+
+
+def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",
+         tag: int = 0) -> None:
+    g = _group(group_name)
+    chan = f"p2p:{g.rank}->{dst_rank}:{tag}"
+    seq = g.next_seq(chan)
+    _post(f"col:{g.name}:{chan}:{seq}", np.asarray(tensor))
+
+
+def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
+         timeout: float = 60.0) -> np.ndarray:
+    g = _group(group_name)
+    chan = f"p2p:{src_rank}->{g.rank}:{tag}"
+    seq = g.next_seq(chan)
+    key = f"col:{g.name}:{chan}:{seq}"
+    value = np.asarray(_wait_key(key, timeout))
+    _client().kv_del(key)  # sole reader: safe to clean eagerly
+    return value

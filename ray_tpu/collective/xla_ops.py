@@ -1,0 +1,72 @@
+"""Device-plane collectives: named-axis wrappers for use inside
+pjit/shard_map programs.
+
+These compile to ICI/DCN collectives — the TPU equivalent of the reference's
+NCCL calls (reference: util/collective/collective_group/
+nccl_collective_group.py allreduce/allgather/reducescatter/send/recv).
+Unlike NCCL, they are *traced*, so XLA overlaps them with compute
+automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def allreduce(x, axis: AxisName, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather(x, axis: AxisName, *, tiled: bool = True, gather_axis: int = 0):
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reducescatter(x, axis: AxisName, *, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def broadcast(x, axis: str, root: int = 0):
+    """Every shard takes root's value along `axis`: mask-then-psum, which
+    costs one allreduce instead of materializing a world_size× all-gather."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def ppermute(x, axis: str, perm: Sequence[tuple]):
+    return lax.ppermute(x, axis, perm)
+
+
+def shift(x, axis: str, offset: int = 1):
+    """Ring shift: each shard receives from (i - offset) % n."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

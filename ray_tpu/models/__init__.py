@@ -1,0 +1,27 @@
+"""Model zoo: pure-functional JAX models with first-class sharding rules.
+
+Unlike the reference (which wraps torch modules in DDP/FSDP/DeepSpeed —
+SURVEY.md §2.4), models here are parameter pytrees + apply functions, and
+parallelism is a ShardingRules table consumed by pjit: DP/FSDP/TP/SP are
+configurations, not code paths.
+"""
+
+from .llama import (
+    LlamaConfig,
+    llama_apply,
+    llama_init,
+    llama_loss,
+    llama_sharding_rules,
+    lora_init,
+    lora_merge,
+    lora_sharding_rules,
+)
+from .mlp import MLPConfig, mlp_apply, mlp_init
+from .train_state import TrainState, make_train_step
+
+__all__ = [
+    "LlamaConfig", "llama_init", "llama_apply", "llama_loss",
+    "llama_sharding_rules", "lora_init", "lora_merge", "lora_sharding_rules",
+    "MLPConfig", "mlp_init", "mlp_apply",
+    "TrainState", "make_train_step",
+]

@@ -1,0 +1,284 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Pure functional: `llama_init` builds a param pytree, `llama_apply` runs the
+forward pass.  Attention goes through the Pallas flash kernel (TPU) or the
+jnp reference (CPU), and through ring attention when the sequence is sharded
+on the `sp` mesh axis.  Sharding is declared in `llama_sharding_rules`
+(megatron TP + FSDP), applied by pjit — no wrapper classes.
+
+LoRA: `lora_init` creates low-rank adapters for the attention projections;
+the base params stay frozen (the Llama-2-7B LoRA fine-tune target in
+BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import flash_attention
+from ..ops.norms import rms_norm
+from ..ops.ring_attention import ring_attention
+from ..ops.rotary import apply_rotary, rope_frequencies
+from ..parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP
+from ..parallel.sharding import ShardingRules
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # remat: rematerialize each block in backward (HBM <-> FLOPs trade)
+    remat: bool = True
+    # sp_axis set -> use ring attention over that mesh axis inside shard_map
+    sp_ring: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def param_count(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        per_layer = (
+            d * d  # wq
+            + 2 * d * self.n_kv_heads * self.head_dim  # wk, wv
+            + d * d  # wo
+            + 3 * d * f  # w1, w2, w3 (w2 transposed)
+            + 2 * d  # norms
+        )
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    # ---- stock sizes ------------------------------------------------------
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(d_model=5120, n_layers=40, n_heads=40,
+                           n_kv_heads=40, d_ff=13824, **kw)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, d_ff=14336,
+                           rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def b1(**kw) -> "LlamaConfig":
+        """~1.2B bench config (fits one v5e chip with activations)."""
+        return LlamaConfig(d_model=2048, n_layers=20, n_heads=16,
+                           n_kv_heads=16, d_ff=5632, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        kw.setdefault("vocab_size", 512)
+        return LlamaConfig(d_model=128, n_layers=2, n_heads=4,
+                           n_kv_heads=2, d_ff=256, max_seq=256, **kw)
+
+
+def llama_init(config: LlamaConfig, key: jax.Array) -> Params:
+    d, f = config.d_model, config.d_ff
+    hd = config.head_dim
+    kv_out = config.n_kv_heads * hd
+    std = d ** -0.5
+    n_keys = 2 + config.n_layers
+    keys = jax.random.split(key, n_keys)
+
+    def dense(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            config.dtype
+        )
+
+    params: Params = {
+        "embed": dense(keys[0], (config.vocab_size, d), 1.0),
+        "final_norm": jnp.ones((d,), config.dtype),
+        "lm_head": dense(keys[1], (d, config.vocab_size), std),
+        "layers": [],
+    }
+    for i in range(config.n_layers):
+        ks = jax.random.split(keys[2 + i], 7)
+        params["layers"].append({
+            "attn_norm": jnp.ones((d,), config.dtype),
+            "attn": {
+                "wq": dense(ks[0], (d, d), std),
+                "wk": dense(ks[1], (d, kv_out), std),
+                "wv": dense(ks[2], (d, kv_out), std),
+                "wo": dense(ks[3], (d, d), std),
+            },
+            "mlp_norm": jnp.ones((d,), config.dtype),
+            "mlp": {
+                "w1": dense(ks[4], (d, f), std),   # gate
+                "w3": dense(ks[5], (d, f), std),   # up
+                "w2": dense(ks[6], (f, d), f ** -0.5),  # down
+            },
+        })
+    return params
+
+
+def llama_sharding_rules() -> ShardingRules:
+    """Megatron TP x FSDP rules (2D); norms replicated.
+    Reference behavior replaced: train_loop_utils.py prepare_model wrappers."""
+    return ShardingRules([
+        (r"embed", P(AXIS_TP, AXIS_FSDP)),
+        (r"lm_head", P(AXIS_FSDP, AXIS_TP)),
+        (r"attn/(wq|wk|wv)", P(AXIS_FSDP, AXIS_TP)),
+        (r"attn/wo", P(AXIS_TP, AXIS_FSDP)),
+        (r"mlp/(w1|w3)", P(AXIS_FSDP, AXIS_TP)),
+        (r"mlp/w2", P(AXIS_TP, AXIS_FSDP)),
+        (r"norm", P()),
+        (r"lora_(a|b)", P()),  # adapters are tiny: replicate
+    ])
+
+
+def _attention(config: LlamaConfig, x, layer, cos, sin, lora_layer=None):
+    B, S, d = x.shape
+    hd = config.head_dim
+    a = layer["attn"]
+    q = x @ a["wq"]
+    k = x @ a["wk"]
+    v = x @ a["wv"]
+    if lora_layer is not None:
+        # LoRA on wq/wv (standard recipe): delta = x @ A @ B * (alpha/r).
+        scale = lora_layer["scale"]
+        q = q + ((x @ lora_layer["wq_lora_a"]) @ lora_layer["wq_lora_b"]) * scale
+        v = v + ((x @ lora_layer["wv_lora_a"]) @ lora_layer["wv_lora_b"]) * scale
+    q = q.reshape(B, S, config.n_heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    # Ring attention engages only when tracing inside shard_map over `sp`
+    # (local-chunk view).  Under plain pjit the tensors are the global view:
+    # positions start at 0 and XLA partitions full attention itself.
+    ring_mode = False
+    if config.sp_ring:
+        try:
+            jax.lax.axis_size(AXIS_SP)
+            ring_mode = True
+        except (NameError, KeyError, TypeError):
+            ring_mode = False
+    if ring_mode:
+        # Local chunk at global offset rank * S_local: RoPE must use global
+        # positions or cross-chunk relative positions are wrong.
+        offset = jax.lax.axis_index(AXIS_SP) * S
+        q = apply_rotary(q, cos, sin, position_offset=offset)
+        k = apply_rotary(k, cos, sin, position_offset=offset)
+        out = ring_attention(q, k, v, axis_name=AXIS_SP, causal=True)
+    else:
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        out = flash_attention(q, k, v, causal=True)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, d)
+    return out @ a["wo"]
+
+
+def _mlp(layer, x):
+    m = layer["mlp"]
+    return (jax.nn.silu(x @ m["w1"]) * (x @ m["w3"])) @ m["w2"]
+
+
+def _block(config: LlamaConfig, x, layer, cos, sin, lora_layer=None):
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    x = x + _attention(config, h, layer, cos, sin, lora_layer)
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    return x + _mlp(layer, h)
+
+
+def llama_apply(
+    config: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,                       # [B, S] int32
+    lora_params: Optional[Params] = None,
+) -> jax.Array:
+    """Returns logits [B, S, vocab]."""
+    x = params["embed"][tokens].astype(config.dtype)
+    cos, sin = rope_frequencies(
+        config.head_dim, config.max_seq, config.rope_theta
+    )
+    block = _block
+    if config.remat:
+        block = jax.checkpoint(
+            _block, static_argnums=(0,), prevent_cse=False
+        )
+    for i, layer in enumerate(params["layers"]):
+        ll = lora_params["layers"][i] if lora_params is not None else None
+        x = block(config, x, layer, cos, sin, ll)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def llama_loss(
+    config: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    lora_params: Optional[Params] = None,
+    ignore_index: int = -100,
+) -> jax.Array:
+    logits = llama_apply(config, params, tokens, lora_params)
+    mask = targets != ignore_index
+    tgt = jnp.where(mask, targets, 0)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    return nll.sum() / jnp.maximum(mask.sum(), 1)
+
+
+# --------------------------------------------------------------------- LoRA
+
+
+def lora_init(config: LlamaConfig, key: jax.Array, rank: int = 16,
+              alpha: float = 32.0) -> Params:
+    """Adapters for wq/wv in every layer (frozen-base fine-tuning)."""
+    d = config.d_model
+    kv_out = config.n_kv_heads * config.head_dim
+    layers = []
+    keys = jax.random.split(key, config.n_layers)
+    for i in range(config.n_layers):
+        k1, k2 = jax.random.split(keys[i])
+        layers.append({
+            "wq_lora_a": (jax.random.normal(k1, (d, rank), jnp.float32)
+                          * (d ** -0.5)).astype(config.dtype),
+            "wq_lora_b": jnp.zeros((rank, d), config.dtype),
+            "wv_lora_a": (jax.random.normal(k2, (d, rank), jnp.float32)
+                          * (d ** -0.5)).astype(config.dtype),
+            "wv_lora_b": jnp.zeros((rank, kv_out), config.dtype),
+            "scale": jnp.asarray(alpha / rank, config.dtype),
+        })
+    return {"layers": layers}
+
+
+def lora_sharding_rules() -> ShardingRules:
+    return ShardingRules([(r"lora", P())])
+
+
+def lora_merge(config: LlamaConfig, params: Params, lora: Params) -> Params:
+    """Fold adapters into base weights (for export/serving)."""
+    out = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+    for i, ll in enumerate(lora["layers"]):
+        a = out["layers"][i]["attn"]
+        scale = ll["scale"].astype(jnp.float32)
+        a["wq"] = (a["wq"].astype(jnp.float32)
+                   + ll["wq_lora_a"].astype(jnp.float32)
+                   @ ll["wq_lora_b"].astype(jnp.float32) * scale
+                   ).astype(config.dtype)
+        a["wv"] = (a["wv"].astype(jnp.float32)
+                   + ll["wv_lora_a"].astype(jnp.float32)
+                   @ ll["wv_lora_b"].astype(jnp.float32) * scale
+                   ).astype(config.dtype)
+    return out

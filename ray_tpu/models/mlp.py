@@ -1,0 +1,51 @@
+"""Small MLP for the MNIST parity smoke test (BASELINE.md north-star row 1:
+"Train-equivalent MNIST MLP (1 worker, CPU) — parity smoke test")."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: int = 256
+    n_hidden: int = 2
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(config: MLPConfig, key: jax.Array) -> Dict:
+    dims = [config.in_dim] + [config.hidden] * config.n_hidden + [config.out_dim]
+    keys = jax.random.split(key, len(dims) - 1)
+    return {
+        "layers": [
+            {
+                "w": (jax.random.normal(k, (a, b), jnp.float32)
+                      * (2.0 / a) ** 0.5).astype(config.dtype),
+                "b": jnp.zeros((b,), config.dtype),
+            }
+            for k, a, b in zip(keys, dims[:-1], dims[1:])
+        ]
+    }
+
+
+def mlp_apply(config: MLPConfig, params: Dict, x: jax.Array) -> jax.Array:
+    h = x.astype(config.dtype)
+    for i, layer in enumerate(params["layers"]):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params["layers"]) - 1:
+            h = jax.nn.relu(h)
+    return h.astype(jnp.float32)
+
+
+def mlp_loss(config: MLPConfig, params: Dict, x: jax.Array,
+             y: jax.Array) -> jax.Array:
+    logits = mlp_apply(config, params, x)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+    return (logz - gold).mean()

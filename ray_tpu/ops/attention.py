@@ -1,0 +1,365 @@
+"""Flash attention: Pallas TPU kernels (forward + backward) with a jnp
+reference fallback for CPU tests.
+
+Design notes (TPU-first):
+- Online-softmax forward keeps the S matrix out of HBM entirely; K/V for one
+  (batch, head) live in VMEM (fine up to ~8k tokens at head_dim 128 bf16 —
+  longer sequences shard over the `sp` mesh axis via ring_attention).
+- Backward is the standard two-kernel split (dq; dk+dv) driven by the saved
+  logsumexp and delta = rowsum(dO * O), so nothing quadratic is
+  rematerialized in HBM.
+- GQA is handled in the BlockSpec index maps (kv head = q head // group), no
+  KV broadcast copies.
+- `q_offset` supports sequence-parallel callers whose Q block sits at a
+  global offset relative to K/V (ring attention steps).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+LSE_LANES = 128  # trailing pad so lse blocks meet TPU tiling
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+# --------------------------------------------------------------- reference
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Plain-XLA attention, [B, H, S, D] layout, GQA-aware.  Used as the
+    numerical reference and the non-TPU fallback."""
+    out, _ = _mha_reference_lse(
+        q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset
+    )
+    return out
+
+
+def _mha_reference_lse(q, k, v, *, causal, sm_scale, q_offset=0):
+    B, H, Sq, D = q.shape
+    Hkv = k.shape[1]
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    if Hkv != H:
+        group = H // Hkv
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        Sk = k.shape[2]
+        qi = jnp.arange(Sq)[:, None] + q_offset
+        ki = jnp.arange(Sk)[None, :]
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return out.astype(q.dtype), lse
+
+
+# ------------------------------------------------------------ pallas forward
+
+
+def _fwd_kernel(q_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, sm_scale, causal, block_k):
+    qb = q_ref[0, 0].astype(jnp.float32) * sm_scale      # [bq, D]
+    bq = qb.shape[0]
+    Sk = k_ref.shape[2]
+    n_kb = Sk // block_k
+    q_idx = pl.program_id(2)
+    q_global = q_idx * bq + q_off_ref[0]                 # global row offset
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        kblk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(qb, kblk.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            mask = (rows + q_global) >= (cols + kb * block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, vblk, preferred_element_type=jnp.float32
+        )
+        return acc, m_cur, l_cur
+
+    acc0 = jnp.zeros((bq, q_ref.shape[3]), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows stay finite
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse = (m + jnp.log(l)).astype(jnp.float32)
+    # lse rides a 128-lane pad: TPU blocks need aligned trailing dims.
+    lse_ref[0, 0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, q_offset, block_q, block_k, interpret):
+    B, H, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    group = H // Hkv
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    grid = (B, H, Sq // bq)
+    q_off = jnp.asarray([q_offset], jnp.int32)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=bk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, *_: (b, h // group, 0, 0)),
+                pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, *_: (b, h // group, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, bq, LSE_LANES),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, LSE_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_off, q, k, v)
+    return out, lse
+
+
+# ----------------------------------------------------------- pallas backward
+
+
+def _bwd_dq_kernel(q_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, sm_scale, causal, block_k):
+    qb = q_ref[0, 0].astype(jnp.float32)
+    dob = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    bq, D = qb.shape
+    Sk = k_ref.shape[2]
+    q_idx = pl.program_id(2)
+    q_global = q_idx * bq + q_off_ref[0]
+
+    def body(kb, dq):
+        kblk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(qb * sm_scale, kblk.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            mask = (rows + q_global) >= (cols + kb * block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        dp = jnp.dot(dob, vblk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, Sk // block_k, body, jnp.zeros((bq, D), jnp.float32)
+    )
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, group):
+    kb_mat = k_ref[0, 0].astype(jnp.float32)                # [bk, D]
+    vb_mat = v_ref[0, 0].astype(jnp.float32)
+    bk, D = kb_mat.shape
+    Sq = q_ref.shape[2]
+    k_idx = pl.program_id(2)
+    q_off = q_off_ref[0]
+
+    def qhead(g, carry):
+        """Accumulate over the `group` q-heads mapping to this kv head."""
+        dk, dv = carry
+
+        def body(qb_i, c):
+            dk, dv = c
+            qb = q_ref[0, g, pl.ds(qb_i * block_q, block_q), :].astype(jnp.float32)
+            dob = do_ref[0, g, pl.ds(qb_i * block_q, block_q), :].astype(jnp.float32)
+            lse = lse_ref[0, g, pl.ds(qb_i * block_q, block_q), 0]
+            delta = delta_ref[0, g, pl.ds(qb_i * block_q, block_q), 0]
+            s = jnp.dot(qb * sm_scale, kb_mat.T,
+                        preferred_element_type=jnp.float32)  # [bqq, bk]
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+                mask = (rows + qb_i * block_q + q_off) >= (cols + k_idx * bk)
+                s = jnp.where(mask, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
+            dp = jnp.dot(dob, vb_mat.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None]) * sm_scale
+            dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+            return dk, dv
+
+        return jax.lax.fori_loop(0, Sq // block_q, body, (dk, dv))
+
+    dk0 = jnp.zeros((bk, D), jnp.float32)
+    dv0 = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, group, qhead, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, *, sm_scale, causal, q_offset, block_q, block_k,
+               interpret):
+    q, k, v, out, lse = res
+    do = g
+    B, H, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    group = H // Hkv
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LSE_LANES,))
+    q_off = jnp.asarray([q_offset], jnp.int32)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=bk
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, Sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, *_: (b, h // group, 0, 0)),
+                pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, *_: (b, h // group, 0, 0)),
+                pl.BlockSpec((1, 1, bq, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, bq, LSE_LANES),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, bq, LSE_LANES),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, *_: (b, h, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q_off, q, k, v, do, lse, delta)
+
+    # dk/dv: grid over kv heads; each kernel instance loops the q-heads in its
+    # GQA group and all q blocks.
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=bq, group=group,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, Hkv, Sk // bk),
+            in_specs=[
+                pl.BlockSpec((1, group, Sq, D), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, bk, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, bk, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, group, Sq, D), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, group, Sq, LSE_LANES),
+                             lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, group, Sq, LSE_LANES),
+                             lambda b, h, i, *_: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bk, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, bk, D), lambda b, h, i, *_: (b, h, i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q_off, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+)
+def _flash(q, k, v, sm_scale, causal, q_offset, block_q, block_k, interpret):
+    out, _ = _flash_fwd(
+        q, k, v, sm_scale, causal, q_offset, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, q_offset, block_q, block_k,
+                   interpret):
+    out, lse = _flash_fwd(
+        q, k, v, sm_scale, causal, q_offset, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(sm_scale, causal, q_offset, block_q, block_k, interpret,
+                   res, g):
+    return _flash_bwd(
+        res, g, sm_scale=sm_scale, causal=causal, q_offset=q_offset,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_offset: int = 0,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    force_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over [batch, heads, seq, head_dim] (GQA: k/v may have
+    fewer heads).  Pallas on TPU; jnp reference elsewhere."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    bq, bk = min(block_q, Sq), min(block_k, Sk)
+    use_pallas = force_pallas or _on_tpu()
+    # The kernels assume block-divisible sequence lengths; odd lengths take
+    # the XLA reference path rather than reading/writing garbage tails.
+    if Sq % bq or Sk % bk:
+        use_pallas = False
+    if not use_pallas:
+        return mha_reference(
+            q, k, v, causal=causal, sm_scale=scale, q_offset=q_offset
+        )
+    return _flash(q, k, v, scale, causal, q_offset, block_q, block_k, interpret)

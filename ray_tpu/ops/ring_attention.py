@@ -1,0 +1,138 @@
+"""Ring attention: causal attention over a sequence sharded on the `sp` mesh
+axis (context parallelism).
+
+Net-new vs the reference (SURVEY.md §5.7: no sequence/context parallelism
+exists in Ray).  Mechanics: each sp-shard holds a contiguous sequence chunk of
+Q/K/V; K/V chunks rotate around the ring via ppermute while each shard
+accumulates its Q-rows' attention with an online-softmax combiner, so the
+full S×S score matrix never materializes and per-chip memory is
+O(S_local²).  XLA overlaps the ppermute with the chunk compute (ICI
+collective-permute).
+
+Call inside shard_map with sequence dim sharded over `axis_name`; falls back
+to plain flash attention when the axis has size 1.
+
+Per-chunk math uses the differentiable blockwise form (checkpointed) rather
+than the Pallas kernel: the ring combiner needs d(lse) contributions, which
+the flash kernel's VJP does not expose.  Fusing ring+flash into one joint
+custom VJP is the known next optimization (striped/blockwise-parallel
+attention).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .attention import NEG_INF, flash_attention
+
+
+def _chunk_attn(q, k, v, scale, mode):
+    """Blockwise attention for one (Q-chunk, K-chunk) pair.
+
+    mode: 0 = skip (K chunk is entirely in the future), 1 = diagonal
+    (causal within chunk), 2 = full (K chunk entirely in the past).
+    Returns (unnormalized accumulator [B,H,S,D] f32, lse [B,H,S] f32).
+    """
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    def compute(causal_mask):
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        if causal_mask:
+            qi = jnp.arange(S)[:, None]
+            ki = jnp.arange(S)[None, :]
+            s = jnp.where(qi >= ki, s, NEG_INF)
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        acc = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        return acc / jnp.maximum(l, 1e-30)[..., None], lse
+
+    def skip(_):
+        return (
+            jnp.zeros((B, H, S, D), jnp.float32),
+            jnp.full((B, H, S), NEG_INF, jnp.float32),
+        )
+
+    return lax.switch(
+        mode,
+        [
+            skip,
+            lambda _: compute(True),
+            lambda _: compute(False),
+        ],
+        None,
+    )
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """[B, H, S_local, D] in, same out.  Must run inside shard_map when the
+    sp axis is >1."""
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    try:
+        n = lax.axis_size(axis_name)
+    except NameError:
+        n = 1
+    if n == 1:
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+    if not causal:
+        # Non-causal: all-gather K/V is simpler and bandwidth-equivalent.
+        kg = lax.all_gather(k, axis_name, axis=2, tiled=True)
+        vg = lax.all_gather(v, axis_name, axis=2, tiled=True)
+        return flash_attention(q, kg, vg, causal=False, sm_scale=scale)
+
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    B, H, S, _ = q.shape
+
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+
+    chunk = jax.checkpoint(functools.partial(_chunk_attn, scale=scale))
+
+    def step(s, carry):
+        k_cur, v_cur, acc, m_run, l_run = carry
+        src = (rank - s) % n  # whose K/V chunk we currently hold
+        # mode: future chunk -> skip; own chunk -> diagonal; past -> full.
+        mode = jnp.where(src > rank, 0, jnp.where(src == rank, 1, 2))
+        out_c, lse_c = chunk(q, k_cur, v_cur, mode=mode)
+        m_new = jnp.maximum(m_run, lse_c)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(lse_c - m_new)
+        acc = acc * alpha[..., None] + out_c * beta[..., None]
+        l_run = l_run * alpha + beta
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, acc, m_new, l_run
+
+    carry = (k, v, acc0, m0, l0)
+    for s in range(n):  # unrolled: n is a small static mesh-axis size
+        carry = step(s, carry)
+    _, _, acc, _, l_run = carry
+    out = acc / jnp.maximum(l_run, 1e-30)[..., None]
+    return out.astype(q.dtype)

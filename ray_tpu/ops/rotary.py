@@ -1,0 +1,42 @@
+"""Rotary position embeddings (RoPE).
+
+Pure jnp by design: RoPE is a cheap elementwise multiply that XLA fuses into
+the surrounding QK projections — a dedicated kernel would only add a
+fusion barrier.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """Returns (cos, sin) tables of shape [max_seq, head_dim // 2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 position_offset: int | jax.Array = 0) -> jax.Array:
+    """Apply RoPE to [batch, heads, seq, head_dim] (pairs-interleaved in the
+    last dim halves convention: x = [x1 | x2])."""
+    seq = x.shape[2]
+    if isinstance(position_offset, int) and position_offset == 0:
+        c = cos[:seq]
+        s = sin[:seq]
+    else:
+        idx = position_offset + jnp.arange(seq)
+        c = cos[idx]
+        s = sin[idx]
+    c = c[None, None, :, :]
+    s = s[None, None, :, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1
+    ).astype(x.dtype)

@@ -1,0 +1,38 @@
+"""TPU parallelism: device meshes, sharding rules, collectives, and the
+multi-host bootstrap.
+
+This is the TPU-native replacement for the reference's NCCL/Gloo collective
+stack (reference: python/ray/util/collective/) and torch process-group
+bootstrap (reference: python/ray/train/torch/config.py:66
+_setup_torch_process_group): the collective *data plane* is XLA ICI/DCN
+collectives inside compiled programs; the host-level rendezvous is
+jax.distributed keyed from cluster metadata.
+"""
+
+from .mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_SP,
+    AXIS_TP,
+    MeshConfig,
+    batch_spec,
+    data_sharding,
+    make_mesh,
+)
+from .sharding import (
+    ShardingRules,
+    infer_param_specs,
+    named_sharding,
+    shard_pytree,
+    with_sharding_constraint,
+)
+from .distributed import initialize_process_group, process_group_barrier
+
+__all__ = [
+    "AXIS_DP", "AXIS_FSDP", "AXIS_TP", "AXIS_SP", "AXIS_EP",
+    "MeshConfig", "make_mesh", "batch_spec", "data_sharding",
+    "ShardingRules", "infer_param_specs", "named_sharding", "shard_pytree",
+    "with_sharding_constraint",
+    "initialize_process_group", "process_group_barrier",
+]

@@ -1,0 +1,85 @@
+"""Multi-host process-group bootstrap over the cluster KV.
+
+Role-equivalent to the reference's torch process-group setup
+(reference: python/ray/train/torch/config.py:66 _setup_torch_process_group —
+rank-0 address broadcast, then dist.init_process_group): here rank-0
+publishes the JAX coordinator address in the cluster KV and every host calls
+jax.distributed.initialize.  After this, jax.devices() spans the whole pod
+and every pjit program is automatically multi-host SPMD.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Optional
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def initialize_process_group(
+    world_size: int,
+    rank: int,
+    *,
+    group_name: str = "default",
+    coordinator_address: Optional[str] = None,
+    timeout_s: float = 120.0,
+) -> None:
+    """Initialize jax.distributed across `world_size` framework workers.
+
+    Rank 0 picks a coordinator port and publishes it via the cluster KV;
+    other ranks poll the KV for it.  Call from inside a task/actor running on
+    each TPU host.  Single-host (world_size=1) is a no-op so the same train
+    loop runs everywhere.
+    """
+    if world_size <= 1:
+        return
+    import jax
+
+    from ..core.context import ctx
+
+    key = f"pg:{group_name}:coordinator"
+    if coordinator_address is None:
+        if ctx.client is None:
+            raise RuntimeError(
+                "initialize_process_group needs a cluster connection "
+                "(or pass coordinator_address explicitly)"
+            )
+        if rank == 0:
+            host = socket.gethostbyname(socket.gethostname())
+            coordinator_address = f"{host}:{_free_port()}"
+            ctx.client.kv_put(key, coordinator_address.encode())
+        else:
+            deadline = time.monotonic() + timeout_s
+            while True:
+                raw = ctx.client.kv_get(key)
+                if raw is not None:
+                    coordinator_address = raw.decode()
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rank {rank}: coordinator address not published"
+                    )
+                time.sleep(0.1)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=world_size,
+        process_id=rank,
+    )
+
+
+def process_group_barrier(group_name: str = "default") -> None:
+    """Host-level barrier across an initialized process group: a tiny psum
+    over all devices forces every host to reach this point."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((jax.local_device_count(),))
+    jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()

@@ -1,0 +1,83 @@
+"""Sharding rules: map parameter-tree paths to PartitionSpecs.
+
+The TPU-native replacement for the reference's wrapper-class parallelism
+(reference: train/torch/train_loop_utils.py prepare_model DDP/FSDP wrapping;
+train/lightning/_lightning_utils.py RayFSDPStrategy): instead of wrapping
+modules, parameters are annotated with PartitionSpecs by regex rules over
+their tree path, and pjit/XLA does the rest.  DP→FSDP→TP are points on the
+same rule table, not different code paths.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) table.  First match wins; default is
+    full replication."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]], default: P = P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, path: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return _clip_spec(spec, ndim)
+        return _clip_spec(self.default, ndim)
+
+    def tree_specs(self, tree: Any) -> Any:
+        """PartitionSpec pytree matching `tree`."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: self.spec_for(_path_str(path), getattr(x, "ndim", 0)),
+            tree,
+        )
+
+
+def _clip_spec(spec: P, ndim: int) -> P:
+    if len(spec) <= ndim:
+        return spec
+    return P(*spec[:ndim])
+
+
+def infer_param_specs(params: Any, rules: ShardingRules) -> Any:
+    return rules.tree_specs(params)
+
+
+def named_sharding(mesh: Mesh, spec_tree: Any) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_pytree(tree: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Place a host pytree onto the mesh according to the rules."""
+    shardings = named_sharding(mesh, rules.tree_specs(tree))
+    return jax.device_put(tree, shardings)
+
+
+def with_sharding_constraint(x, spec: P):
+    """Annotation helper usable inside jit (no-op outside a mesh context)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x

@@ -8,8 +8,26 @@ python/ray/tests/conftest.py ray_start_regular / cluster_utils.Cluster).
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process.  Forced
+# (not setdefault): the surrounding env may point JAX at the real TPU chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Keep accelerator-tunnel sitecustomize hooks dormant in test workers.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+# A sitecustomize hook (TPU tunnel) plus pytest plugins (jaxtyping) can
+# import jax and initialize the TPU backend before this conftest runs —
+# after which XLA_FLAGS has already been parsed.  Force re-selection onto
+# the virtual 8-device CPU platform via jax's own config (not XLA_FLAGS).
+import jax
+
+try:
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,162 @@
+"""Model tests: tiny-Llama forward/training (replicated and 2D-sharded on the
+virtual mesh), LoRA, MLP convergence."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import (
+    LlamaConfig,
+    MLPConfig,
+    TrainState,
+    llama_apply,
+    llama_init,
+    llama_loss,
+    llama_sharding_rules,
+    lora_init,
+    lora_merge,
+    make_train_step,
+    mlp_init,
+)
+from ray_tpu.models.mlp import mlp_loss
+from ray_tpu.models.train_state import default_optimizer, shard_train_state
+from ray_tpu.parallel import MeshConfig, make_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _tokens(cfg, B=2, S=64, seed=1):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (B, S), 0, cfg.vocab_size
+    )
+
+
+class TestLlama:
+    def test_forward_shapes(self, tiny):
+        cfg, params = tiny
+        toks = _tokens(cfg)
+        logits = llama_apply(cfg, params, toks)
+        assert logits.shape == (2, 64, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_causality(self, tiny):
+        """Changing a future token must not change past logits."""
+        cfg, params = tiny
+        toks = _tokens(cfg, B=1)
+        logits1 = llama_apply(cfg, params, toks)
+        toks2 = toks.at[0, -1].set((toks[0, -1] + 1) % cfg.vocab_size)
+        logits2 = llama_apply(cfg, params, toks2)
+        np.testing.assert_allclose(
+            logits1[0, :-1], logits2[0, :-1], atol=1e-5
+        )
+        assert float(jnp.abs(logits1[0, -1] - logits2[0, -1]).max()) > 1e-4
+
+    def test_loss_decreases(self, tiny):
+        cfg, params = tiny
+        toks = _tokens(cfg, B=4, S=32)
+        targets = jnp.roll(toks, -1, axis=1)
+        tx = default_optimizer(lr=1e-3)
+        state = TrainState.create(jax.tree.map(jnp.copy, params), tx)
+        step = make_train_step(
+            lambda p, b: llama_loss(cfg, p, b["tokens"], b["targets"]), tx
+        )
+        batch = {"tokens": toks, "targets": targets}
+        losses = []
+        for _ in range(8):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] - 0.1, losses
+
+    def test_sharded_train_step_2d(self, tiny):
+        """fsdp=4 x tp=2 over the 8-device CPU mesh; results must match the
+        replicated step."""
+        cfg, params = tiny
+        mesh = make_mesh(MeshConfig(fsdp=4, tp=2))
+        rules = llama_sharding_rules()
+        toks = _tokens(cfg, B=4, S=32)
+        batch = {"tokens": toks, "targets": jnp.roll(toks, -1, axis=1)}
+        tx = default_optimizer(lr=1e-3)
+        loss_fn = lambda p, b: llama_loss(cfg, p, b["tokens"], b["targets"])
+
+        state_r = TrainState.create(jax.tree.map(jnp.copy, params), tx)
+        step_r = make_train_step(loss_fn, tx)
+        state_s = shard_train_state(
+            TrainState.create(jax.tree.map(jnp.copy, params), tx), mesh, rules
+        )
+        step_s = make_train_step(loss_fn, tx, mesh, rules)
+
+        with jax.set_mesh(mesh):
+            for _ in range(2):
+                state_s, m_s = step_s(state_s, batch)
+        for _ in range(2):
+            state_r, m_r = step_r(state_r, batch)
+        assert abs(float(m_s["loss"]) - float(m_r["loss"])) < 1e-3
+        # A sharded param really is distributed.
+        wq = state_s.params["layers"][0]["attn"]["wq"]
+        assert not wq.sharding.is_fully_replicated
+
+    def test_lora(self, tiny):
+        cfg, params = tiny
+        lora = lora_init(cfg, jax.random.PRNGKey(1), rank=4)
+        toks = _tokens(cfg, B=2, S=32)
+        # B zero-initialized: LoRA output == base output initially.
+        base = llama_apply(cfg, params, toks)
+        with_lora = llama_apply(cfg, params, toks, lora)
+        np.testing.assert_allclose(base, with_lora, atol=1e-6)
+
+        # Train only the adapters; base stays frozen.
+        targets = jnp.roll(toks, -1, axis=1)
+        tx = default_optimizer(lr=1e-2)
+        state = TrainState.create(jax.tree.map(jnp.copy, lora), tx)
+        step = make_train_step(
+            lambda lp, b: llama_loss(cfg, params, b["tokens"], b["targets"], lp),
+            tx,
+        )
+        batch = {"tokens": toks, "targets": targets}
+        l0 = None
+        for _ in range(5):
+            state, m = step(state, batch)
+            l0 = l0 or float(m["loss"])
+        assert float(m["loss"]) < l0
+
+        # Merge: merged model output == adapter-applied output.
+        merged = lora_merge(cfg, params, state.params)
+        np.testing.assert_allclose(
+            llama_apply(cfg, merged, toks),
+            llama_apply(cfg, params, toks, state.params),
+            atol=2e-3, rtol=2e-3,
+        )
+
+    def test_gqa_config(self):
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+        assert cfg.n_kv_heads < cfg.n_heads  # tiny config exercises GQA
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+        logits = llama_apply(cfg, params, _tokens(cfg, B=1, S=16))
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_param_count_7b(self):
+        assert abs(LlamaConfig.llama2_7b().param_count() / 6.74e9 - 1) < 0.02
+
+
+class TestMLP:
+    def test_converges(self):
+        cfg = MLPConfig(in_dim=16, hidden=32, out_dim=4)
+        params = mlp_init(cfg, jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        x = jax.random.normal(key, (256, 16))
+        y = (x.sum(axis=1) > 0).astype(jnp.int32) + 2 * (x[:, 0] > 0).astype(jnp.int32)
+        tx = default_optimizer(lr=1e-2)
+        state = TrainState.create(params, tx)
+        step = make_train_step(lambda p, b: mlp_loss(cfg, p, b["x"], b["y"]), tx)
+        for _ in range(60):
+            state, m = step(state, {"x": x, "y": y})
+        assert float(m["loss"]) < 0.5
