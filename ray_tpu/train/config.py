@@ -1,0 +1,78 @@
+"""Train configuration dataclasses.
+
+Role-equivalent to the reference's air/config.py (RunConfig, ScalingConfig,
+FailureConfig, CheckpointConfig) and air/result.py (Result) — with the
+TPU-first difference that ScalingConfig describes a device mesh per worker
+(dp/fsdp/tp/sp) instead of GPU counts, making DP→FSDP→TP/SP a config change
+rather than new wrapper code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ..parallel.mesh import MeshConfig
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many framework workers, with what resources, and how each worker's
+    devices form a mesh (reference: air/config.py ScalingConfig)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    # Mesh over each worker's visible devices (single-host) or over the whole
+    # pod after jax.distributed init (multi-host gang).
+    mesh: Optional[MeshConfig] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """(reference: air/config.py FailureConfig) — max_failures < 0 means
+    unlimited restarts."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """(reference: air/config.py CheckpointConfig)"""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+
+
+@dataclasses.dataclass
+class Result:
+    """(reference: air/result.py Result)"""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional["Checkpoint"]  # noqa: F821
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: Optional[list] = None
+
+    @property
+    def best_checkpoints(self):
+        return self._best_checkpoints
+
+    _best_checkpoints: list = dataclasses.field(default_factory=list)

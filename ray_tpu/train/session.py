@@ -1,0 +1,136 @@
+"""Worker-side train session: report(), get_checkpoint(), rank info, dataset
+shards.
+
+Role-equivalent to the reference's per-worker _TrainSession
+(reference: train/_internal/session.py — report:403, public report:667,
+get_checkpoint:754) with the same synchronous-collective semantics: report()
+blocks until the driver has consumed the round, keeping workers in lockstep
+(which is exactly what an SPMD TPU job wants).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import tempfile
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+from .checkpoint import Checkpoint
+
+_session: Optional["TrainSession"] = None
+
+
+class TrainSession:
+    def __init__(
+        self,
+        world_rank: int,
+        world_size: int,
+        trial_dir: str,
+        restored_checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[Dict[str, Any]] = None,
+    ):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.trial_dir = trial_dir
+        self.restored_checkpoint = restored_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.result_queue: "queue.Queue" = queue.Queue()
+        self.consumed = threading.Semaphore(0)
+        self.step = 0
+        self.finished = False
+
+    # ---- called from user train loop ----------------------------------------
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self.step += 1
+        persisted = None
+        if checkpoint is not None:
+            # Stage the worker's checkpoint under the trial dir so it outlives
+            # the user's temp directory.
+            dest = os.path.join(
+                self.trial_dir, "staging",
+                f"step_{self.step:06d}_rank_{self.world_rank}",
+            )
+            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            persisted = dest
+        self.result_queue.put(
+            {"metrics": dict(metrics), "checkpoint_dir": persisted,
+             "step": self.step, "rank": self.world_rank}
+        )
+        # Lockstep with the driver (reference behavior: session.report blocks
+        # until the round is processed).
+        self.consumed.acquire()
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.restored_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r}")
+        return shard
+
+    # ---- called from the actor's polling method -----------------------------
+
+    def next_result(self, timeout: float = 3600.0) -> Optional[dict]:
+        try:
+            return self.result_queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def ack(self):
+        self.consumed.release()
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    _session = TrainSession(**kwargs)
+    return _session
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No train session: this API must be called inside a train loop "
+            "launched by a Trainer."
+        )
+    return _session
+
+
+def shutdown_session():
+    global _session
+    _session = None
+
+
+# ---- public module-level API (mirrors ray.train.*) --------------------------
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().get_dataset_shard(name)
+
+
+class TrainContext:
+    def get_world_rank(self) -> int:
+        return get_session().world_rank
+
+    def get_world_size(self) -> int:
+        return get_session().world_size
+
+    def get_trial_dir(self) -> str:
+        return get_session().trial_dir
+
+
+def get_context() -> TrainContext:
+    return TrainContext()

@@ -1,0 +1,167 @@
+"""WorkerGroup: a gang of train-worker actors.
+
+Role-equivalent to the reference's WorkerGroup + BackendExecutor
+(reference: train/_internal/worker_group.py:102, backend_executor.py:68):
+spawns N actors into a placement group, initializes the process group
+(jax.distributed analog of _setup_torch_process_group), runs the user train
+loop, and relays session reports.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from . import session as session_mod
+from .checkpoint import Checkpoint
+
+
+@ray_tpu.remote(max_concurrency=4)
+class TrainWorker:
+    """One rank of the gang.  max_concurrency lets poll()/ack() run while the
+    train loop blocks inside run()."""
+
+    def __init__(self, rank: int, world_size: int, trial_dir: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.trial_dir = trial_dir
+        self.session = None
+
+    def setup(
+        self,
+        restored_ckpt_path: Optional[str],
+        dataset_shards: Optional[Dict[str, Any]],
+        collective_group: Optional[str],
+    ):
+        from . import session as smod
+
+        self.session = smod.init_session(
+            world_rank=self.rank,
+            world_size=self.world_size,
+            trial_dir=self.trial_dir,
+            restored_checkpoint=(
+                Checkpoint(restored_ckpt_path) if restored_ckpt_path else None
+            ),
+            dataset_shards=dataset_shards,
+        )
+        if collective_group is not None:
+            from ..collective import init_collective_group
+
+            init_collective_group(
+                self.world_size, self.rank, group_name=collective_group
+            )
+        return self.rank
+
+    def run(self, fn_blob: bytes, config: Optional[dict]):
+        """Execute the user train loop; always ends with a 'done' sentinel."""
+        fn = cloudpickle.loads(fn_blob)
+        try:
+            if config is not None:
+                fn(config)
+            else:
+                fn()
+            self.session.result_queue.put({"done": True, "rank": self.rank})
+        except BaseException as e:  # noqa: BLE001 — relayed to the driver
+            import traceback
+
+            self.session.result_queue.put({
+                "done": True, "rank": self.rank,
+                "error": f"{e}\n{traceback.format_exc()}",
+            })
+        finally:
+            self.session.finished = True
+
+    def poll(self, timeout: float = 600.0):
+        return self.session.next_result(timeout=timeout)
+
+    def ack(self):
+        self.session.ack()
+        return True
+
+    def _init_collective(self, world_size, rank, group_name):
+        from ..collective import init_collective_group
+
+        init_collective_group(world_size, rank, group_name=group_name)
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
+                 trial_dir: str, placement_strategy: str = "PACK"):
+        self.num_workers = num_workers
+        self.trial_dir = trial_dir
+        self.pg = None
+        if num_workers > 1:
+            try:
+                self.pg = ray_tpu.placement_group(
+                    [dict(resources_per_worker) for _ in range(num_workers)],
+                    strategy=placement_strategy,
+                )
+            except RuntimeError:
+                self.pg = None  # infeasible bundles: fall back to best-effort
+        opts: Dict[str, Any] = {"num_cpus": resources_per_worker.get("CPU", 1)}
+        if resources_per_worker.get("TPU"):
+            opts["num_tpus"] = resources_per_worker["TPU"]
+        self.workers: List[Any] = []
+        for rank in range(num_workers):
+            cls = TrainWorker
+            if self.pg is not None:
+                cls = TrainWorker.options(
+                    scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
+                        self.pg, rank
+                    ),
+                    **opts,
+                )
+            else:
+                cls = TrainWorker.options(**opts)
+            self.workers.append(
+                cls.remote(rank, num_workers,
+                           os.path.join(trial_dir, f"rank_{rank}"))
+            )
+
+    def setup(self, restored_ckpt: Optional[str],
+              dataset_shards: Optional[List[Dict[str, Any]]],
+              collective_group: Optional[str]):
+        refs = [
+            w.setup.remote(
+                restored_ckpt,
+                dataset_shards[i] if dataset_shards else None,
+                collective_group,
+            )
+            for i, w in enumerate(self.workers)
+        ]
+        return ray_tpu.get(refs)
+
+    def start_training(self, fn: Callable, config: Optional[dict]):
+        blob = cloudpickle.dumps(fn)
+        self.run_refs = [w.run.remote(blob, config) for w in self.workers]
+
+    def poll_all(self, ranks: Optional[List[int]] = None,
+                 timeout: float = 600.0) -> List[dict]:
+        targets = (
+            self.workers if ranks is None else [self.workers[r] for r in ranks]
+        )
+        return ray_tpu.get(
+            [w.poll.remote(timeout) for w in targets],
+            timeout=timeout + 60,
+        )
+
+    def ack_all(self, ranks: Optional[List[int]] = None):
+        targets = (
+            self.workers if ranks is None else [self.workers[r] for r in ranks]
+        )
+        ray_tpu.get([w.ack.remote() for w in targets])
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        if self.pg is not None:
+            try:
+                ray_tpu.remove_placement_group(self.pg)
+            except Exception:
+                pass
