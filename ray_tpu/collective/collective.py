@@ -14,6 +14,7 @@ weight broadcast.  Bulk tensor traffic belongs on the device plane
 
 from __future__ import annotations
 
+import os
 import pickle
 import time
 from typing import Any, Dict, List, Optional
@@ -25,15 +26,23 @@ _POLL_S = 0.002
 
 
 class GroupState:
-    def __init__(self, world_size: int, rank: int, name: str):
+    def __init__(self, world_size: int, rank: int, name: str, gen: int):
         self.world_size = world_size
         self.rank = rank
         self.name = name
+        # Incarnation generation: re-creating a group with the same name
+        # (elastic restart) gets a fresh generation, so no op can ever read
+        # a previous incarnation's KV keys.
+        self.gen = gen
         # Per-tag op counters: collectives stay aligned because every rank
         # calls the same collectives in the same order; p2p counters are
         # per (src, dst, tag) so asymmetric send/recv patterns can't
         # desynchronize the rendezvous keys.
         self.seqs: Dict[str, int] = {}
+
+    @property
+    def ns(self) -> str:
+        return f"col:{self.name}:g{self.gen}"
 
     def next_seq(self, tag: str) -> int:
         self.seqs[tag] = self.seqs.get(tag, 0) + 1
@@ -56,12 +65,85 @@ def _group(name: str) -> GroupState:
     return g
 
 
+def _del_prefix(prefix: str) -> None:
+    c = _client()
+    for k in c.kv_keys(prefix):
+        c.kv_del(k)
+
+
+def _rendezvous_generation(world_size: int, rank: int, name: str,
+                           timeout: float) -> int:
+    """Agree on a fresh incarnation generation for (re-)initialized groups.
+
+    Elastic restarts re-create groups under the same name after the previous
+    gang died; without a fresh namespace, barrier/allreduce would consume the
+    dead incarnation's KV keys.  Protocol (incarnations are sequential —
+    the old gang is gone before the new one initializes):
+
+    - rank 0 deletes stale hello keys, bumps the integer generation, purges
+      any keys under the new namespace, then welcomes each joiner by its
+      process-unique uuid with the new generation.
+    - other ranks repeatedly post a uuid-keyed hello and poll for their own
+      welcome; the uuid guarantees the welcome they read is from *this*
+      incarnation's rank 0.
+    """
+    c = _client()
+    hello_prefix = f"col:{name}:hello:"
+    deadline = time.monotonic() + timeout
+    if rank == 0:
+        _del_prefix(hello_prefix)
+        _del_prefix(f"col:{name}:welcome:")  # unconsumed stale welcomes
+        raw = c.kv_get(f"col:{name}:gen")
+        gen = (int(raw) if raw else 0) + 1
+        _del_prefix(f"col:{name}:g{gen}:")
+        c.kv_put(f"col:{name}:gen", str(gen).encode())
+        seen: Dict[int, None] = {}
+        welcomed: set = set()
+        while len(seen) < world_size - 1:
+            for k in c.kv_keys(hello_prefix):
+                _, _, _, r_str, uuid = k.split(":", 4)
+                # Welcome each uuid exactly once: the joiner deletes the key
+                # on read, and re-putting it would leak it forever.
+                if uuid not in welcomed:
+                    welcomed.add(uuid)
+                    c.kv_put(f"col:{name}:welcome:{uuid}",
+                             str(gen).encode())
+                seen[int(r_str)] = None
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective init: only {len(seen) + 1}/{world_size} "
+                    f"ranks arrived for group {name!r}"
+                )
+            time.sleep(_POLL_S)
+        return gen
+    uuid = os.urandom(8).hex()
+    welcome_key = f"col:{name}:welcome:{uuid}"
+    while True:
+        # Repost each round: rank 0 deletes hello keys posted before its
+        # purge; reposting guarantees eventual delivery.
+        c.kv_put(hello_prefix + f"{rank}:{uuid}", b"1")
+        raw = c.kv_get(welcome_key)
+        if raw is not None:
+            c.kv_del(welcome_key)
+            return int(raw)
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective init: rank {rank} saw no rank 0 for {name!r}"
+            )
+        time.sleep(_POLL_S * 10)
+
+
 def init_collective_group(
-    world_size: int, rank: int, *, group_name: str = "default", backend: str = "kv"
+    world_size: int, rank: int, *, group_name: str = "default",
+    backend: str = "kv", timeout: float = 120.0,
 ) -> None:
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
-    _groups[group_name] = GroupState(world_size, rank, group_name)
+    if world_size == 1:
+        _groups[group_name] = GroupState(1, 0, group_name, 0)
+        return
+    gen = _rendezvous_generation(world_size, rank, group_name, timeout)
+    _groups[group_name] = GroupState(world_size, rank, group_name, gen)
     barrier(group_name)  # rendezvous: everyone must arrive
 
 
@@ -82,7 +164,24 @@ def create_collective_group(
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups.get(group_name)
+    if g is None:
+        return
+    # All ranks barrier so rank 0's sweep can't race in-flight ops; if some
+    # peer never calls destroy the barrier times out and the sweep proceeds
+    # (the next incarnation uses a fresh namespace regardless).
+    if g.world_size > 1:
+        try:
+            barrier(group_name, timeout=5.0)
+        except Exception:
+            pass
     _groups.pop(group_name, None)
+    if g.rank == 0:
+        try:
+            _del_prefix(g.ns + ":")
+            _del_prefix(f"col:{g.name}:hello:")
+        except Exception:
+            pass
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -114,7 +213,7 @@ def _wait_key(key: str, timeout: float) -> Any:
 
 def _gather_all(g: GroupState, tag: str, value, timeout: float) -> List[Any]:
     seq = g.next_seq(tag)
-    base = f"col:{g.name}:{tag}:{seq}"
+    base = f"{g.ns}:{tag}:{seq}"
     _post(f"{base}:{g.rank}", value)
     out = [
         _wait_key(f"{base}:{r}", timeout) if r != g.rank else value
@@ -123,7 +222,7 @@ def _gather_all(g: GroupState, tag: str, value, timeout: float) -> List[Any]:
     # Lazy cleanup: delete our rank's key from two ops ago (everyone has
     # certainly consumed it — op N+1 acted as a barrier).
     if seq > 2:
-        _client().kv_del(f"col:{g.name}:{tag}:{seq - 2}:{g.rank}")
+        _client().kv_del(f"{g.ns}:{tag}:{seq - 2}:{g.rank}")
     return out
 
 
@@ -165,11 +264,11 @@ def broadcast(tensor: Optional[np.ndarray], *, group_name: str = "default",
               root: int = 0, timeout: float = 60.0) -> np.ndarray:
     g = _group(group_name)
     seq = g.next_seq(f"bc{root}")
-    key = f"col:{g.name}:bc{root}:{seq}"
+    key = f"{g.ns}:bc{root}:{seq}"
     if g.rank == root:
         _post(key, np.asarray(tensor))
         if seq > 2:  # lazy cleanup of an op every rank has long consumed
-            _client().kv_del(f"col:{g.name}:bc{root}:{seq - 2}")
+            _client().kv_del(f"{g.ns}:bc{root}:{seq - 2}")
         return np.asarray(tensor)
     return np.asarray(_wait_key(key, timeout))
 
@@ -184,7 +283,7 @@ def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",
     g = _group(group_name)
     chan = f"p2p:{g.rank}->{dst_rank}:{tag}"
     seq = g.next_seq(chan)
-    _post(f"col:{g.name}:{chan}:{seq}", np.asarray(tensor))
+    _post(f"{g.ns}:{chan}:{seq}", np.asarray(tensor))
 
 
 def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
@@ -192,7 +291,7 @@ def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
     g = _group(group_name)
     chan = f"p2p:{src_rank}->{g.rank}:{tag}"
     seq = g.next_seq(chan)
-    key = f"col:{g.name}:{chan}:{seq}"
+    key = f"{g.ns}:{chan}:{seq}"
     value = np.asarray(_wait_key(key, timeout))
     _client().kv_del(key)  # sole reader: safe to clean eagerly
     return value

@@ -42,7 +42,14 @@ class PlacementGroup:
         self.strategy = strategy
 
     def ready(self, timeout: float = 30.0) -> bool:
-        return True  # creation is synchronous in this control plane
+        """Block until the group's bundles are reserved (False on timeout).
+        Feasible-but-busy groups queue head-side until resources free up
+        (reference: gcs_placement_group_manager pending queue)."""
+        return ctx.client.call(
+            "pg_ready",
+            {"pg_id": self.id.binary(), "timeout": timeout},
+            timeout=timeout + 30,
+        )["ready"]
 
     def __reduce__(self):
         return (PlacementGroup, (self.id, self.bundles, self.strategy))
@@ -155,6 +162,7 @@ def init(
             server_thread.run_coro(
                 _prestart_workers(head, prestart)
             ).result(timeout=10)
+            server_thread.run_coro(head.start_periodic()).result(timeout=10)
             ctx.head_process = (head, server_thread)
             address = f"127.0.0.1:{port}"
             os.environ["RT_ADDRESS"] = address
@@ -191,6 +199,14 @@ def shutdown():
             return
         head_proc = ctx.head_process
         client = ctx.client
+        # Flush pending ObjectRef frees so a long-lived driver doesn't leave
+        # up to a batch of shm segments behind.
+        from .object_ref import _flush_free_queue
+
+        try:
+            _flush_free_queue()
+        except Exception:
+            pass
         try:
             if head_proc is not None:
                 head, server_thread = head_proc
@@ -587,10 +603,12 @@ def placement_group(
             "name": name,
         },
     )
-    if not reply["created"]:
+    if reply.get("infeasible"):
         raise RuntimeError(
             f"placement group infeasible: bundles={bundles} strategy={strategy}"
+            " cannot fit even on an empty cluster"
         )
+    # created or queued: either way the handle is valid; ready() blocks.
     return PlacementGroup(pg_id, bundles, strategy)
 
 

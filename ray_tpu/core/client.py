@@ -8,6 +8,7 @@ worker_main.py.  One Client per process (driver or worker).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -88,13 +89,39 @@ class Client:
             )
         return size
 
+    @contextlib.contextmanager
+    def _maybe_blocked(self):
+        """Tell the head this worker is parked in a blocking get/wait so its
+        task's resources can be released (and a replacement worker spawned) —
+        without this, nested gets deeper than the worker-pool cap deadlock
+        (reference: raylet releases the CPU lease for workers blocked in
+        ray.get).  Actor tasks hold no pool resources, so they skip it."""
+        from .context import ctx
+
+        tid = ctx.current_task_id
+        if self.kind != "worker" or tid is None or ctx.current_actor_id is not None:
+            yield
+            return
+        try:
+            self.rpc.call("task_blocked", {"task_id": tid.binary()})
+        except Exception:
+            pass
+        try:
+            yield
+        finally:
+            try:
+                self.rpc.call("task_unblocked", {"task_id": tid.binary()})
+            except Exception:
+                pass
+
     def get_raw(self, object_ids: Sequence[ObjectID], timeout: float = -1.0):
         """Fetch wire descriptors for objects (blocking until sealed)."""
-        reply = self.rpc.call(
-            "get_objects",
-            {"object_ids": [o.binary() for o in object_ids], "timeout": timeout},
-            timeout=1e9 if timeout < 0 else timeout + 30,
-        )
+        with self._maybe_blocked():
+            reply = self.rpc.call(
+                "get_objects",
+                {"object_ids": [o.binary() for o in object_ids], "timeout": timeout},
+                timeout=1e9 if timeout < 0 else timeout + 30,
+            )
         return reply["objects"]
 
     def get(self, refs: Sequence, timeout: float = -1.0) -> List[Any]:
@@ -130,15 +157,16 @@ class Client:
         return serialization.unpack(view)
 
     def wait(self, refs: Sequence, num_returns: int, timeout: float):
-        reply = self.rpc.call(
-            "wait_objects",
-            {
-                "object_ids": [r.object_id.binary() for r in refs],
-                "num_returns": num_returns,
-                "timeout": timeout,
-            },
-            timeout=1e9 if timeout < 0 else timeout + 30,
-        )
+        with self._maybe_blocked():
+            reply = self.rpc.call(
+                "wait_objects",
+                {
+                    "object_ids": [r.object_id.binary() for r in refs],
+                    "num_returns": num_returns,
+                    "timeout": timeout,
+                },
+                timeout=1e9 if timeout < 0 else timeout + 30,
+            )
         ready_set = set(reply["ready"])
         ready = [r for r in refs if r.object_id.binary() in ready_set]
         not_ready = [r for r in refs if r.object_id.binary() not in ready_set]
@@ -154,10 +182,11 @@ class Client:
             pass
 
     def next_stream_item(self, task_id: bytes, index: int) -> dict:
-        return self.rpc.call(
-            "next_stream_item", {"task_id": task_id, "index": index},
-            timeout=1e9,
-        )
+        with self._maybe_blocked():
+            return self.rpc.call(
+                "next_stream_item", {"task_id": task_id, "index": index},
+                timeout=1e9,
+            )
 
     # -- KV --------------------------------------------------------------------
 

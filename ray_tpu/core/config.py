@@ -43,18 +43,26 @@ class Config:
     # threshold, then spread (reference:
     # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h spread_threshold).
     scheduler_spread_threshold: float = 0.5
-    # Cap on concurrent pending lease requests per scheduling class
-    # (reference: normal_task_submitter.h max_pending_lease_requests).
-    max_pending_leases_per_scheduling_class: int = 10
     # -- workers --------------------------------------------------------------
     num_workers: int = 0  # 0 => num_cpus
+    # A spawned worker process that hasn't registered within this window is
+    # presumed dead; its spawn slot is reclaimed so the pool can retry.
     worker_register_timeout_s: float = 30.0
+    # Idle task-workers older than this are reaped by the head's periodic
+    # loop (reference: worker_pool.h idle worker killing).
     idle_worker_killing_time_s: float = 300.0
     # -- fault tolerance ------------------------------------------------------
     default_task_max_retries: int = 3
     default_actor_max_restarts: int = 0
-    health_check_period_s: float = 1.0
-    health_check_failure_threshold: int = 5
+    # Liveness probing of worker/node processes whose TCP connection is still
+    # open but whose event loop has wedged (reference:
+    # gcs_health_check_manager.h).  Probes every period; declared dead after
+    # `threshold` consecutive missed acks.  The 30s default window is
+    # deliberately generous: a worker mid-way through one long GIL-holding
+    # C call (huge unpickle, big numpy ufunc) can't ack from its rpc thread
+    # and must not be shot for it.
+    health_check_period_s: float = 5.0
+    health_check_failure_threshold: int = 6
     # -- RPC ------------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 * 1024 * 1024

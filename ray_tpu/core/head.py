@@ -35,6 +35,9 @@ from .scheduler import ClusterScheduler, SchedulingStrategy
 # Worker / actor / task states (subset of the reference FSMs:
 # gcs_actor_manager.h actor FSM, worker_pool.h worker states).
 STARTING, IDLE, LEASED, ACTOR, DEAD = "starting", "idle", "leased", "actor", "dead"
+# BLOCKED: leased worker parked in a nested get/wait; its task's resources
+# are released so the pool can run other work (see h_task_blocked).
+BLOCKED = "blocked"
 PENDING, RUNNING, FINISHED, FAILED = "PENDING", "RUNNING", "FINISHED", "FAILED"
 
 
@@ -62,11 +65,16 @@ class WorkerState:
         self.state = STARTING
         self.inflight: Set[TaskID] = set()  # tasks currently on this worker
         self.actor_id: Optional[ActorID] = None
-        self.last_seen = time.monotonic()
+        self.last_seen = time.monotonic()  # last dispatch/completion activity
+        self.last_ack = time.monotonic()   # last health-check ack
+
+
+_task_seq = 0
 
 
 class TaskRecord:
     def __init__(self, spec: dict):
+        global _task_seq
         self.spec = spec
         self.task_id = TaskID(spec["task_id"])
         self.state = PENDING
@@ -77,6 +85,11 @@ class TaskRecord:
         self.start_time = 0.0
         self.end_time = 0.0
         self.error: Optional[str] = None
+        # Submission order (used to restore FIFO when in-flight actor tasks
+        # are requeued after a worker death) and blocked-in-get flag.
+        _task_seq += 1
+        self.seq = _task_seq
+        self.blocked = False
 
     @property
     def is_actor_task(self) -> bool:
@@ -167,8 +180,17 @@ class Head:
         self.local_node_id: Optional[NodeID] = None
         self.worker_procs: List[subprocess.Popen] = []
         self.node_daemons: Dict[NodeID, Connection] = {}
+        # Object-plane server address per node (chunked pull endpoint).
+        self.node_object_addrs: Dict[NodeID, str] = {}
+        self.node_last_ack: Dict[NodeID, float] = {}
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
         self._spawn_pending: Dict[NodeID, int] = {}
+        self._spawn_times: Dict[NodeID, deque] = {}
+        # Placement groups waiting for resources to free up (reference:
+        # gcs_placement_group_manager queues pending PGs).
+        self.pending_pgs: "Dict[PlacementGroupID, dict]" = {}
+        self.pg_waiters: Dict[PlacementGroupID, List[asyncio.Event]] = {}
+        self._periodic_task: Optional[asyncio.Task] = None
         self._shutdown = False
         self.job_start_time = time.time()
 
@@ -183,8 +205,15 @@ class Head:
             "publish", "subscribe", "cluster_resources", "available_resources",
             "next_stream_item", "list_state", "ping", "shutdown_cluster",
             "actor_restarting", "restore_object", "store_stats",
+            "task_blocked", "task_unblocked", "health_ack", "pg_ready",
+            "node_health_ack",
         ]:
             self.server.register(name, getattr(self, f"h_{name}"))
+        # The head serves chunked pulls for its own node's objects
+        # (remote nodes serve theirs via their daemon's object-plane server).
+        from .node_main import make_pull_handler
+
+        self.server.register("pull_object", make_pull_handler(self.store))
         self.server.on_disconnect = self._on_disconnect
 
     # ------------------------------------------------------------------ utils
@@ -234,10 +263,84 @@ class Head:
 
     async def start(self) -> int:
         self.port = await self.server.start()
+        await self.start_periodic()
         return self.port
+
+    async def start_periodic(self):
+        """Launch the housekeeping loop on the serving event loop (callers
+        that start the RpcServer directly must invoke this themselves)."""
+        if self._periodic_task is None:
+            self._periodic_task = asyncio.ensure_future(self._periodic_loop())
+
+    async def _periodic_loop(self):
+        """Housekeeping: worker health probes, idle-worker reaping, spawn
+        timeout reclamation, pending-PG retry (reference:
+        gcs_health_check_manager.h, worker_pool.h idle killing)."""
+        cfg = self.config
+        period = max(0.1, min(cfg.health_check_period_s, 1.0))
+        while not self._shutdown:
+            try:
+                await asyncio.sleep(period)
+                now = time.monotonic()
+                # Health probes: push to every worker; acks come back via
+                # h_health_ack.  A wedged process keeps the TCP connection
+                # open but its rpc loop stops acking.
+                dead_after = cfg.health_check_period_s * cfg.health_check_failure_threshold
+                for w in list(self.workers.values()):
+                    if not w.conn.alive:
+                        continue
+                    try:
+                        await w.conn.push("health_check", {})
+                    except Exception:
+                        continue
+                    if now - w.last_ack > dead_after:
+                        self._event("worker_health_timeout",
+                                    worker=w.worker_id.hex())
+                        if w.node_id == self.local_node_id:
+                            try:
+                                os.kill(w.pid, 9)
+                            except (ProcessLookupError, PermissionError):
+                                pass
+                        w.conn.writer.close()  # triggers _on_disconnect
+                # Node-daemon liveness (reference: GcsHealthCheckManager
+                # probes every raylet).
+                for node_id, conn in list(self.node_daemons.items()):
+                    try:
+                        await conn.push("health_check", {})
+                    except Exception:
+                        continue
+                    last = self.node_last_ack.get(node_id, now)
+                    if now - last > dead_after:
+                        self._event("node_health_timeout", node=node_id.hex())
+                        conn.writer.close()  # triggers node-death handling
+                # Idle reaping: task-pool workers idle beyond the window exit
+                # cleanly; demand respawns them.
+                idle_t = cfg.idle_worker_killing_time_s
+                for w in list(self.workers.values()):
+                    if (w.state == IDLE and w.conn.alive
+                            and now - w.last_seen > idle_t):
+                        try:
+                            await w.conn.push("shutdown", {})
+                        except Exception:
+                            pass
+                # Spawn-timeout: reclaim slots of workers that never
+                # registered so _maybe_spawn can retry.
+                for node_id, times in self._spawn_times.items():
+                    while times and now - times[0] > cfg.worker_register_timeout_s:
+                        times.popleft()
+                        if self._spawn_pending.get(node_id, 0) > 0:
+                            self._spawn_pending[node_id] -= 1
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
 
     async def stop(self):
         self._shutdown = True
+        if self._periodic_task is not None:
+            self._periodic_task.cancel()
         for w in self.workers.values():
             if w.conn.alive:
                 try:
@@ -260,6 +363,7 @@ class Head:
         self.node_worker_caps[node_id] = num_workers
         self.node_worker_counts[node_id] = 0
         self._spawn_pending[node_id] = 0
+        self.node_object_addrs[node_id] = f"{self.host}:{self.port}"
         return node_id
 
     def _spawn_worker(self, node_id: NodeID):
@@ -294,6 +398,7 @@ class Head:
         )
         daemon = self.node_daemons.get(node_id)
         self._spawn_pending[node_id] = self._spawn_pending.get(node_id, 0) + 1
+        self._spawn_times.setdefault(node_id, deque()).append(time.monotonic())
         if daemon is not None:
             asyncio.ensure_future(daemon.push("spawn_worker", {}))
             return
@@ -325,8 +430,12 @@ class Head:
             self.workers[worker_id] = w
             self.conn_to_worker[conn.conn_id] = worker_id
             conn.meta["kind"] = "worker"
+            conn.meta["reader_node"] = node_id
             if self._spawn_pending.get(node_id, 0) > 0:
                 self._spawn_pending[node_id] -= 1
+                times = self._spawn_times.get(node_id)
+                if times:
+                    times.popleft()
             self.node_worker_counts[node_id] = (
                 self.node_worker_counts.get(node_id, 0) + 1
             )
@@ -339,11 +448,15 @@ class Head:
             self.node_worker_counts[node_id] = 0
             self._spawn_pending[node_id] = 0
             self.node_daemons[node_id] = conn
+            if body.get("object_addr"):
+                self.node_object_addrs[node_id] = body["object_addr"]
+            self.node_last_ack[node_id] = time.monotonic()
             conn.meta["kind"] = "node"
             conn.meta["node_id"] = node_id
             self._kick()
             return {"session": self.session, "node_id": node_id.binary()}
         conn.meta["kind"] = kind  # driver
+        conn.meta["reader_node"] = self.local_node_id
         return {
             "session": self.session,
             "node_id": self.local_node_id.binary() if self.local_node_id else b"",
@@ -356,7 +469,13 @@ class Head:
         node_id = conn.meta.get("node_id")
         if node_id is not None and conn.meta.get("kind") == "node":
             self.node_daemons.pop(node_id, None)
+            self.node_object_addrs.pop(node_id, None)
+            self.node_last_ack.pop(node_id, None)
             self.scheduler.remove_node(node_id)
+            # Objects whose only copy lived there are gone; purge locations
+            # so readers fail fast (lineage reconstruction can then kick in).
+            for rec in self.objects.values():
+                rec.locations.discard(node_id)
             for w in [w for w in self.workers.values() if w.node_id == node_id]:
                 await self._handle_worker_death(w.worker_id)
         for topic_subs in self.subs.values():
@@ -401,13 +520,20 @@ class Head:
         return {}
 
     def _adopt_local(self, oid: ObjectID, node_id: Optional[NodeID]):
-        """Account a shm object in the local store daemon (enables eviction,
-        spilling, and shutdown cleanup)."""
+        """Account a shm object with its node's store daemon (enables
+        eviction, spilling, and shutdown cleanup): local objects go into the
+        head's own store; remote ones get an adopt push to the node daemon."""
         if node_id == self.local_node_id:
             try:
                 self.store.adopt(oid)
             except (FileNotFoundError, MemoryError):
                 pass
+            return
+        daemon = self.node_daemons.get(node_id)
+        if daemon is not None:
+            asyncio.ensure_future(
+                daemon.push("adopt_object", {"object_id": oid.binary()})
+            )
 
     async def h_restore_object(self, conn, body):
         """Re-materialize a spilled object into shm so a reader can attach."""
@@ -447,21 +573,29 @@ class Head:
         # The driver process frees local-node segments (see api.Client).
         await self._publish("object_free", body)
 
-    def _object_wire(self, rec: ObjectRecord) -> dict:
+    def _object_wire(self, rec: ObjectRecord,
+                     prefer: Optional[NodeID] = None) -> dict:
         if rec.error is not None:
             return {"error": rec.error}
         if rec.inline is not None:
             return {"inline": rec.inline}
-        loc = next(iter(rec.locations), None)
+        # Prefer a copy on the reader's own node (shm attach, zero-copy);
+        # otherwise any live location, served over its node's pull endpoint.
+        if prefer is not None and prefer in rec.locations:
+            loc = prefer
+        else:
+            loc = next(iter(rec.locations), None)
         return {
             "size": rec.size,
             "session": self.node_sessions.get(loc, self.session),
             "node_id": loc.binary() if loc else None,
+            "addr": self.node_object_addrs.get(loc),
         }
 
     async def h_get_objects(self, conn, body):
         timeout = body.get("timeout", -1.0)
         deadline = None if timeout < 0 else time.monotonic() + timeout
+        prefer = conn.meta.get("reader_node")
         out = []
         for raw in body["object_ids"]:
             oid = ObjectID(raw)
@@ -479,7 +613,7 @@ class Head:
                     out.append({"timeout": True})
                     break
             else:
-                out.append(self._object_wire(rec))
+                out.append(self._object_wire(rec, prefer))
         return {"objects": out}
 
     async def h_wait_objects(self, conn, body):
@@ -536,21 +670,40 @@ class Head:
                 task.pending_deps.add(oid)
                 self.tasks_waiting_on.setdefault(oid, set()).add(task.task_id)
 
-    def _finalize_task(self, task: TaskRecord):
-        """Terminal-state cleanup: unpin args, prune the record."""
+    def _decref(self, oid: ObjectID):
+        rec = self.objects.get(oid)
+        if rec is None:
+            return
+        rec.ref_count -= 1
+        if rec.ref_count <= 0:
+            self.objects.pop(oid, None)
+            self.store.free(oid)
+
+    def _unpin_task_args(self, task: TaskRecord):
         for raw in task.spec.get("arg_ids", []):
             oid = ObjectID(raw)
-            rec = self.objects.get(oid)
-            if rec is not None:
-                rec.ref_count -= 1
-                if rec.ref_count <= 0:
-                    self.objects.pop(oid, None)
-                    self.store.free(oid)
+            self._decref(oid)
             waiting = self.tasks_waiting_on.get(oid)
             if waiting is not None:
                 waiting.discard(task.task_id)
                 if not waiting:
                     self.tasks_waiting_on.pop(oid, None)
+
+    def _finalize_task(self, task: TaskRecord):
+        """Terminal-state cleanup: unpin args, prune the record."""
+        self._unpin_task_args(task)
+        # The large-args spill object is pinned only by its creation
+        # reference; it dies with the task — except for the creation task of
+        # a live actor, whose restart resubmits the same spec and must be
+        # able to re-read the args (freed at permanent actor death instead).
+        args_ref = task.spec.get("args_ref")
+        if args_ref is not None:
+            keep = False
+            if task.spec.get("is_actor_creation"):
+                actor = self.actors.get(ActorID(task.spec["actor_id"]))
+                keep = actor is not None and actor.state != "DEAD"
+            if not keep:
+                self._decref(ObjectID(args_ref))
         self.finished_tasks.append(
             {
                 "task_id": task.task_id.hex(),
@@ -586,6 +739,8 @@ class Head:
         (reference: src/ray/raylet/local_task_manager.h:58)."""
         if self._shutdown:
             return
+        if self.pending_pgs:
+            self._try_pending_pgs()
         made_progress = True
         while made_progress and self.queued_tasks:
             made_progress = False
@@ -639,6 +794,7 @@ class Head:
         task.worker_id = worker.worker_id
         task.node_id = worker.node_id
         task.start_time = time.time()
+        worker.last_seen = time.monotonic()
         is_actor_creation = task.spec.get("is_actor_creation", False)
         worker.state = ACTOR if is_actor_creation else LEASED
         worker.inflight.add(task.task_id)
@@ -749,12 +905,58 @@ class Head:
             release = task.state in (FAILED, PENDING)
         else:
             release = True
-        if release and task.node_id is not None:
+        # A task still flagged blocked already released its resources in
+        # h_task_blocked (e.g. its unblock RPC was lost).
+        if release and task.node_id is not None and not task.blocked:
             self.scheduler.release(task.node_id, task.resources, task.strategy)
+        task.blocked = False
         if worker:
             worker.inflight.discard(task.task_id)
+            worker.last_seen = time.monotonic()
             if not keep_worker_busy:
                 worker.state = IDLE
+
+    # -- blocked workers (reference: raylet releases the CPU lease while a
+    # worker blocks in ray.get; worker_pool.h spawns past the cap for it) ----
+
+    async def h_task_blocked(self, conn, body):
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        worker = self.workers.get(worker_id) if worker_id else None
+        task = self.tasks.get(TaskID(body["task_id"]))
+        if (task is None or worker is None or task.blocked
+                or task.state != RUNNING or worker.state != LEASED
+                or task.is_actor_task):
+            return {}
+        task.blocked = True
+        worker.state = BLOCKED
+        self.scheduler.release(task.node_id, task.resources, task.strategy)
+        self._kick()  # freed resources may unblock queued tasks
+        return {}
+
+    async def h_task_unblocked(self, conn, body):
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        worker = self.workers.get(worker_id) if worker_id else None
+        task = self.tasks.get(TaskID(body["task_id"]))
+        if task is None or not task.blocked:
+            return {}
+        task.blocked = False
+        if worker is not None and worker.state == BLOCKED:
+            worker.state = LEASED
+        # Oversubscribes transiently if the freed resources were re-used;
+        # self-corrects as running tasks finish.
+        self.scheduler.acquire_force(task.node_id, task.resources, task.strategy)
+        return {}
+
+    async def h_health_ack(self, conn, body):
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        w = self.workers.get(worker_id) if worker_id else None
+        if w is not None:
+            w.last_ack = time.monotonic()
+        return {}
+
+    async def h_node_health_ack(self, conn, body):
+        self.node_last_ack[NodeID(body["node_id"])] = time.monotonic()
+        return {}
 
     async def h_stream_item(self, conn, body):
         task_id = body["task_id"]
@@ -924,11 +1126,13 @@ class Head:
                 except (ProcessLookupError, PermissionError):
                     pass
         else:
-            actor.state = "DEAD"
-            actor.death_cause = "killed via kill_actor"
-            if actor.name:
-                self.named_actors.pop(actor.name, None)
-            await self._fail_actor_queue(actor, None)
+            if actor.state != "DEAD":
+                actor.state = "DEAD"
+                actor.death_cause = "killed via kill_actor"
+                if actor.name:
+                    self.named_actors.pop(actor.name, None)
+                await self._fail_actor_queue(actor, None)
+                self._free_actor_creation_args(actor)
         return {"killed": True}
 
     async def h_actor_restarting(self, conn, body):
@@ -979,16 +1183,38 @@ class Head:
                 creation_tid = TaskID(actor.spec["creation_task"]["task_id"])
                 will_restart_actor = actor.restarts_left != 0
 
+        requeued_actor_tasks: List[TaskRecord] = []
         for tid in list(worker.inflight):
             task = self.tasks.get(tid)
             if task is None or task.state != RUNNING:
                 continue
             if tid == creation_tid and will_restart_actor:
-                continue  # restart path below resubmits this spec
-            # Actor tasks don't hold scheduler resources (the actor does).
-            if not task.spec.get("actor_id") or task.spec.get("is_actor_creation"):
+                # The restart path below resubmits this spec; the resubmitted
+                # copy re-acquires at dispatch, so the running copy's
+                # resources must be released here or the node leaks them.
+                if not task.blocked:
+                    self.scheduler.release(
+                        task.node_id, task.resources, task.strategy
+                    )
+                continue
+            # Actor tasks don't hold scheduler resources (the actor does);
+            # a blocked task already released its resources in h_task_blocked.
+            if (not task.spec.get("actor_id") or task.spec.get("is_actor_creation")) \
+                    and not task.blocked:
                 self.scheduler.release(task.node_id, task.resources, task.strategy)
-            if task.retries_left != 0 and not task.spec.get("actor_id"):
+            task.blocked = False
+            if task.is_actor_task and will_restart_actor and task.retries_left != 0:
+                # In-flight actor tasks survive the restart: requeue them at
+                # the front so the restarted actor re-executes them in order
+                # (reference: task_manager.cc resubmits actor tasks honoring
+                # max_task_retries after actor restart).
+                task.retries_left -= 1
+                task.state = PENDING
+                task.worker_id = None
+                task.node_id = None
+                self._event("task_retry", task=task.task_id.hex())
+                requeued_actor_tasks.append(task)
+            elif task.retries_left != 0 and not task.spec.get("actor_id"):
                 task.retries_left -= 1
                 task.state = PENDING
                 task.worker_id = None
@@ -1018,6 +1244,10 @@ class Head:
         if worker.actor_id is not None:
             actor = self.actors.get(worker.actor_id)
             if actor is not None and actor.state != "DEAD":
+                # Surviving in-flight tasks go back to the front of the
+                # actor's queue in submission order.
+                for task in sorted(requeued_actor_tasks, key=lambda t: -t.seq):
+                    actor.pending_tasks.appendleft(task)
                 # Release the actor's creation resources (unless the creation
                 # task itself was still running — handled in the loop above).
                 ct = self.tasks.get(TaskID(actor.spec["creation_task"]["task_id"]))
@@ -1031,7 +1261,14 @@ class Head:
                         f"actor:{actor.actor_id.hex()}", {"state": "RESTARTING"}
                     )
                     # Re-submit the creation task
-                    # (reference: gcs_actor_manager.cc RestartActor).
+                    # (reference: gcs_actor_manager.cc RestartActor).  The
+                    # orphaned running record shares the task id; drop its
+                    # arg pins first or re-registration double-pins them.
+                    old_ct = self.tasks.get(
+                        TaskID(actor.spec["creation_task"]["task_id"])
+                    )
+                    if old_ct is not None:
+                        self._unpin_task_args(old_ct)
                     ct2 = TaskRecord(dict(actor.spec["creation_task"]))
                     self._register_task(ct2)
                     if not ct2.pending_deps:
@@ -1045,20 +1282,81 @@ class Head:
                         f"actor:{actor.actor_id.hex()}", {"state": "DEAD"}
                     )
                     await self._fail_actor_queue(actor, None)
+                    self._free_actor_creation_args(actor)
         self._kick()
+
+    def _free_actor_creation_args(self, actor: ActorRecord):
+        """Drop the creation-task large-args pin at permanent actor death
+        (the creation task itself finalized long ago with keep=True)."""
+        args_ref = actor.spec["creation_task"].get("args_ref")
+        if args_ref is not None:
+            self._decref(ObjectID(args_ref))
 
     # -- placement groups ------------------------------------------------------
 
     async def h_create_placement_group(self, conn, body):
         pg_id = PlacementGroupID(body["pg_id"])
+        strategy = body.get("strategy", "PACK")
+        if not self.scheduler.check_feasible_ever(body["bundles"], strategy):
+            return {"created": False, "infeasible": True}
         ok = self.scheduler.create_placement_group(
-            pg_id, body["bundles"], body.get("strategy", "PACK"),
-            body.get("name", ""),
+            pg_id, body["bundles"], strategy, body.get("name", "")
         )
-        return {"created": ok}
+        if ok:
+            self._notify_pg_ready(pg_id)
+        else:
+            # Feasible but resources are busy: queue until they free up
+            # (reference: gcs_placement_group_manager pending queue).
+            self.pending_pgs[pg_id] = body
+        return {"created": ok, "queued": not ok}
+
+    def _notify_pg_ready(self, pg_id: PlacementGroupID):
+        for ev in self.pg_waiters.pop(pg_id, []):
+            ev.set()
+
+    def _try_pending_pgs(self):
+        for pg_id in list(self.pending_pgs):
+            body = self.pending_pgs[pg_id]
+            if self.scheduler.create_placement_group(
+                pg_id, body["bundles"], body.get("strategy", "PACK"),
+                body.get("name", ""),
+            ):
+                del self.pending_pgs[pg_id]
+                self._notify_pg_ready(pg_id)
+            else:
+                break  # FIFO fairness: head-of-line blocks later PGs
+
+    async def h_pg_ready(self, conn, body):
+        pg_id = PlacementGroupID(body["pg_id"])
+        timeout = body.get("timeout", 30.0)
+        deadline = time.monotonic() + timeout
+        while pg_id in self.pending_pgs:
+            ev = asyncio.Event()
+            waiters = self.pg_waiters.setdefault(pg_id, [])
+            waiters.append(ev)
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"ready": False}
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    return {"ready": False}
+            finally:
+                # Drop our event on timeout so repeated ready() polls on a
+                # long-pending PG don't accumulate waiters.
+                cur = self.pg_waiters.get(pg_id)
+                if cur is not None and ev in cur:
+                    cur.remove(ev)
+        pg = self.scheduler.placement_groups.get(pg_id)
+        return {"ready": pg is not None and pg.created}
 
     async def h_remove_placement_group(self, conn, body):
-        self.scheduler.remove_placement_group(PlacementGroupID(body["pg_id"]))
+        pg_id = PlacementGroupID(body["pg_id"])
+        self.pending_pgs.pop(pg_id, None)
+        self._notify_pg_ready(pg_id)
+        self.scheduler.remove_placement_group(pg_id)
+        self._kick()
         return {}
 
     # -- pubsub (reference: src/ray/pubsub/publisher.h) ------------------------

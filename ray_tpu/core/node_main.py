@@ -1,0 +1,191 @@
+"""Node daemon: the per-host runtime for non-head nodes.
+
+Role-equivalent to the reference's raylet main
+(reference: src/ray/raylet/main.cc, node_manager.h:119) combined with the
+object-manager transfer server (src/ray/object_manager/object_manager.h:117):
+
+- registers the node (resources, labels, worker cap, store session, and the
+  address of its object-plane server) with the head,
+- owns the node's shared-memory ObjectStore (accounting, LRU eviction,
+  spill/restore) for segments created by its workers,
+- spawns worker processes when the head pushes ``spawn_worker`` (the lease
+  protocol stays centralized in the head; this daemon is the arm that forks
+  processes on the right host),
+- serves chunked ``pull_object`` reads so any process in the cluster can
+  fetch this node's objects over TCP (the analog of the reference's chunked
+  object push/pull, object_manager.h:63 object_chunk_size).
+
+Scheduling decisions stay in the head — a deliberate simplification vs the
+reference's distributed raylet scheduler that a TPU cluster's scale profile
+(hundreds of hosts, gang-scheduled jobs) tolerates well.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .config import get_config
+from .ids import NodeID, ObjectID
+from .object_store import ObjectStore
+from .rpc import RpcClient, RpcServer, ServerThread
+
+PULL_CHUNK_BYTES = 4 * 1024 * 1024
+
+
+def make_pull_handler(store: ObjectStore):
+    """Chunked object reads from a node store.  Shared by the node daemon and
+    the head (which serves its own local node's objects)."""
+
+    async def h_pull_object(conn, body):
+        oid = ObjectID(body["object_id"])
+        view = store.get(oid)  # restores from spill if needed
+        if view is None:
+            return {"found": False}
+        offset = body.get("offset", 0)
+        max_bytes = body.get("max_bytes", PULL_CHUNK_BYTES)
+        chunk = bytes(view[offset:offset + max_bytes])
+        return {"found": True, "size": len(view), "data": chunk}
+
+    return h_pull_object
+
+
+class NodeDaemon:
+    def __init__(self):
+        cfg = get_config()
+        self.head_addr = os.environ["RT_HEAD_ADDR"]
+        self.session = os.environ.get(
+            "RT_NODE_SESSION", f"node-{os.urandom(6).hex()}"
+        )
+        self.resources = json.loads(os.environ.get("RT_NODE_RESOURCES", "{}"))
+        self.labels = json.loads(os.environ.get("RT_NODE_LABELS", "{}"))
+        self.num_workers = int(os.environ.get("RT_NODE_NUM_WORKERS", "4"))
+        self.host = os.environ.get("RT_NODE_HOST", "127.0.0.1")
+        self.store = ObjectStore(
+            self.session, cfg.object_store_memory, cfg.spill_dir
+        )
+        self.server = RpcServer(host=self.host)
+        self.server.register("pull_object", make_pull_handler(self.store))
+        self.server.register("ping", lambda conn, body: {"ok": True})
+        self.server_thread = ServerThread(self.server)
+        self.worker_procs: List[subprocess.Popen] = []
+        self.node_id: Optional[NodeID] = None
+        self.head: Optional[RpcClient] = None
+        self._shutdown = threading.Event()
+
+    def start(self):
+        port = self.server_thread.start()
+        self.head = RpcClient(
+            *self._split(self.head_addr), name="node-daemon-rpc"
+        )
+        self.head.on_push("spawn_worker", self._on_spawn_worker)
+        self.head.on_push("free_objects", self._on_free_objects)
+        self.head.on_push("adopt_object", self._on_adopt_object)
+        self.head.on_push("shutdown", lambda b: self._shutdown.set())
+        self.head.on_push(
+            "health_check",
+            lambda b: self.head.call_async(
+                "node_health_ack", {"node_id": self.node_id.binary()}
+            ) if self.node_id else None,
+        )
+        self.head.on_connection_lost = lambda: os._exit(0)
+        reply = self.head.call(
+            "register",
+            {
+                "kind": "node",
+                "resources": self.resources,
+                "labels": self.labels,
+                "num_workers": self.num_workers,
+                "store_session": self.session,
+                "object_addr": f"{self.host}:{port}",
+            },
+        )
+        self.node_id = NodeID(reply["node_id"])
+
+    @staticmethod
+    def _split(addr: str):
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+
+    # -- push handlers (run on the head-client rpc loop thread) ---------------
+
+    def _on_spawn_worker(self, body):
+        env = dict(os.environ)
+        for k in list(env):
+            if k.startswith(("PALLAS_AXON", "TPU_", "AXON_")):
+                env.pop(k)
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_parent
+        )
+        env.update(
+            RT_HEAD_ADDR=self.head_addr,
+            RT_NODE_ID=self.node_id.hex(),
+            RT_SESSION=self.session,
+            JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+        )
+        log_dir = os.path.join("/tmp/ray_tpu_logs", self.session)
+        os.makedirs(log_dir, exist_ok=True)
+        logf = open(
+            os.path.join(log_dir, f"worker-{time.time_ns()}.log"), "wb"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+        )
+        logf.close()
+        self.worker_procs.append(proc)
+
+    def _on_free_objects(self, body):
+        for raw in body.get("object_ids", []):
+            try:
+                self.store.free(ObjectID(raw))
+            except Exception:
+                pass
+
+    def _on_adopt_object(self, body):
+        """Take accounting ownership of a segment a local worker created
+        (the head routes this to the object's node)."""
+        try:
+            self.store.adopt(ObjectID(body["object_id"]))
+        except (FileNotFoundError, MemoryError):
+            pass
+
+    # ------------------------------------------------------------------ loop
+
+    def run(self):
+        while not self._shutdown.wait(timeout=0.2):
+            # Reap exited worker processes so they don't zombie.
+            for p in self.worker_procs:
+                p.poll()
+        for p in self.worker_procs:
+            if p.poll() is None:
+                p.terminate()
+        self.store.shutdown()
+        os._exit(0)
+
+
+def main():
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1)
+    daemon = NodeDaemon()
+    daemon.start()
+    daemon.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

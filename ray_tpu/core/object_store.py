@@ -13,10 +13,7 @@ Implementation notes (TPU-first design):
   processes still map).  This mirrors plasma's fd-passing model with the unix
   permissions model doing the access control.
 - Device arrays never live here: XLA owns TPU HBM.  The store holds host
-  bytes; the TPU edge is `jax.device_put` at consumption time (see
-  ray_tpu.data iterators).
-- A C++ arena (ray_tpu/_native) can replace the per-object-file backend
-  behind the same interface; see ray_tpu/core/native_store.py.
+  bytes; the TPU edge is `jax.device_put` at consumption time.
 """
 
 from __future__ import annotations
@@ -251,6 +248,32 @@ class StoreClient:
         with self._lock:
             self._attached[object_id] = seg
         return seg.view()
+
+    def create_staged(self, object_id: ObjectID, size: int):
+        """Create a segment at a temporary name; committing renames it to the
+        object's canonical path atomically.  Used for inter-node pulls where
+        several processes may fetch the same object concurrently — readers
+        must never attach a partially-written segment (reference: plasma
+        objects are invisible until sealed)."""
+        final = _seg_path(self._session, object_id)
+        tmp = f"{final}.pull-{os.getpid()}-{os.urandom(4).hex()}"
+        seg = _Segment(tmp, size, create=True)
+
+        def commit() -> memoryview:
+            os.rename(tmp, final)
+            seg.path = final
+            with self._lock:
+                self._attached[object_id] = seg
+            return seg.view()
+
+        def abort():
+            seg.close()
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+
+        return seg.view(), commit, abort
 
     def get(self, object_id: ObjectID, timeout: float = 0.0) -> Optional[memoryview]:
         with self._lock:

@@ -23,15 +23,33 @@ import msgpack
 REQ, RESP, ERR, PUSH = 0, 1, 2, 3
 _HDR = struct.Struct("<I")
 
+_max_msg_bytes: Optional[int] = None
+
+
+def _msg_limit() -> int:
+    global _max_msg_bytes
+    if _max_msg_bytes is None:
+        from .config import get_config
+
+        _max_msg_bytes = get_config().rpc_max_message_bytes
+    return _max_msg_bytes
+
 
 def _encode(msg) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
+    if len(body) > _msg_limit():
+        raise RpcError(
+            f"rpc message of {len(body)} bytes exceeds rpc_max_message_bytes "
+            f"({_msg_limit()}); route bulk data through the object store"
+        )
     return _HDR.pack(len(body)) + body
 
 
 async def _read_msg(reader: asyncio.StreamReader):
     hdr = await reader.readexactly(_HDR.size)
     (n,) = _HDR.unpack(hdr)
+    if n > _msg_limit():
+        raise RpcError(f"incoming rpc frame of {n} bytes exceeds limit")
     body = await reader.readexactly(n)
     return msgpack.unpackb(body, raw=False, strict_map_key=False)
 
@@ -171,8 +189,10 @@ class RpcClient:
         self._reader_task = None
         self.closed = False
         self.on_connection_lost: Optional[Callable[[], None]] = None
+        from .config import get_config
+
         fut = asyncio.run_coroutine_threadsafe(self._connect(), self._loop)
-        fut.result(timeout=30)
+        fut.result(timeout=get_config().rpc_connect_timeout_s)
 
     async def _connect(self):
         self._reader, self._writer = await asyncio.open_connection(

@@ -234,6 +234,57 @@ class ClusterScheduler:
         _sub(node.available, resources)
         return True
 
+    def acquire_force(
+        self,
+        node_id: NodeID,
+        resources: ResourceDict,
+        strategy: SchedulingStrategy | None = None,
+    ) -> None:
+        """Acquire without a feasibility check (availability may go negative).
+
+        Used when a worker resumes from a blocked get/wait: its resources were
+        released while it was parked so other tasks could run (reference:
+        raylet releases CPU for workers blocked in ray.get), and on resume it
+        must get them back even if that oversubscribes the node transiently —
+        the deficit self-corrects as running tasks finish."""
+        if strategy and strategy.kind == "placement_group":
+            pg = self.placement_groups.get(strategy.pg_id)
+            if pg is not None:
+                indices = (
+                    [strategy.bundle_index]
+                    if strategy.bundle_index >= 0
+                    else range(len(pg.bundles))
+                )
+                for i in indices:
+                    b = pg.bundles[i]
+                    if b.node_id == node_id:
+                        _sub(b.available, resources)
+                        return
+            return
+        node = self.nodes.get(node_id)
+        if node is not None:
+            _sub(node.available, resources)
+
+    def check_feasible_ever(
+        self, bundles: Sequence[ResourceDict], strategy: str
+    ) -> bool:
+        """Would these bundles fit on an *empty* cluster of the current
+        nodes?  Distinguishes 'queue until resources free up' from 'can
+        never be satisfied' for placement-group admission."""
+        saved = {nid: n.available for nid, n in self.nodes.items()}
+        try:
+            for n in self.nodes.values():
+                n.available = dict(n.total)
+            probe = PlacementGroup(
+                pg_id=PlacementGroupID.nil(),
+                bundles=[Bundle(resources=dict(b)) for b in bundles],
+                strategy=PlacementStrategy(strategy),
+            )
+            return self._place_bundles(probe) is not None
+        finally:
+            for nid, n in self.nodes.items():
+                n.available = saved[nid]
+
     def release(
         self,
         node_id: NodeID,

@@ -71,6 +71,13 @@ class Worker:
         self.client.rpc.on_push("cancel", self._on_cancel)
         self.client.rpc.on_push("shutdown", lambda b: self._shutdown.set())
         self.client.rpc.on_push("exit", lambda b: os._exit(1))
+        # Liveness probe: ack from the rpc loop thread (call_async is safe
+        # there; a blocking call would deadlock the loop).  A wedged
+        # interpreter stops acking and the head reaps us.
+        self.client.rpc.on_push(
+            "health_check",
+            lambda b: self.client.rpc.call_async("health_ack", {}),
+        )
         self.client.rpc.on_connection_lost = lambda: os._exit(0)
         # Handshake: only now may the head lease us (push handlers installed).
         self.client.call("worker_ready", {})

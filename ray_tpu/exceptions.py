@@ -39,6 +39,16 @@ class ActorDiedError(RayTpuError):
         self.actor_id_hex = actor_id_hex
         self.cause = cause
 
+    def __reduce__(self):
+        # Default Exception reduce would re-init with the formatted message
+        # as actor_id_hex, garbling both attributes after crossing the wire.
+        return (type(self), (self.actor_id_hex, self.cause))
+
+
+class ObjectReconstructionFailedError(RayTpuError):
+    """Lineage reconstruction was attempted for a lost object but failed
+    (depth limit, missing lineage, or the re-executed task failed)."""
+
 
 class ObjectLostError(RayTpuError):
     pass

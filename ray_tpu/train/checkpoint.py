@@ -4,8 +4,8 @@ Role-equivalent to the reference's train/_checkpoint.py:56 (Checkpoint as a
 directory on a filesystem) and train/_internal/checkpoint_manager.py (top-K
 by score).  Storage is a filesystem path (shared FS or local); model-state
 serialization itself is the caller's business — `save_pytree`/`load_pytree`
-helpers cover the common JAX case via orbax when available, msgpack-numpy
-otherwise.
+helpers cover the common JAX case (device→host transfer + pickle-5 with
+out-of-band-capable numpy arrays; arbitrary pytree structures round-trip).
 """
 
 from __future__ import annotations

@@ -1,0 +1,243 @@
+"""Regression tests for control-plane fault-tolerance semantics:
+
+- blocked-worker resource release (nested gets deeper than the pool cap)
+- actor max_task_retries across worker death (in-flight call survives restart)
+- large-arg object lifetime (no shm leak after the task finishes)
+- placement-group pending queue + ready()
+- health-check reaping of wedged workers; idle-worker reaping
+- collective group re-initialization under the same name (fresh incarnation)
+
+Models the reference's python/ray/tests/test_failure*.py and
+test_placement_group*.py coverage.
+"""
+
+import os
+import pickle
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def _fresh(**kw):
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(**kw)
+    return ray_tpu
+
+
+@pytest.fixture
+def rt2():
+    """Tiny worker pool: forces the blocked-worker paths."""
+    rt = _fresh(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_nested_get_beyond_worker_cap(rt2):
+    """Recursive fan deeper than the pool cap must not deadlock: a worker
+    blocked in get releases its CPU so a replacement can run the child."""
+
+    @ray_tpu.remote
+    def nest(depth):
+        if depth == 0:
+            return 1
+        return 1 + ray_tpu.get(nest.remote(depth - 1))
+
+    assert ray_tpu.get(nest.remote(5), timeout=60) == 6
+
+
+def test_blocked_wait_releases_resources(rt2):
+    @ray_tpu.remote
+    def child():
+        return "c"
+
+    @ray_tpu.remote
+    def parent():
+        refs = [child.remote() for _ in range(3)]
+        ready, _ = ray_tpu.wait(refs, num_returns=3, timeout=30)
+        return len(ready)
+
+    assert ray_tpu.get(parent.remote(), timeout=60) == 3
+
+
+def test_actor_task_retry_on_worker_death(rt2):
+    """An in-flight actor call survives the actor's worker dying when
+    max_task_retries allows: it is requeued and re-executed after restart."""
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class Slow:
+        def work(self, t):
+            time.sleep(t)
+            return "done"
+
+    a = Slow.remote()
+    ray_tpu.get(a.work.remote(0))  # actor is up
+    ref = a.work.remote(2.0)
+    time.sleep(0.3)  # the call is in flight now
+    ray_tpu.kill(a, no_restart=False)
+    assert ray_tpu.get(ref, timeout=60) == "done"
+
+
+def test_actor_calls_queue_during_restart(rt2):
+    """Calls submitted while the actor restarts queue transparently instead
+    of failing (reference: client-side queueing during RESTARTING)."""
+
+    @ray_tpu.remote(max_restarts=2)
+    class Crasher:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    a = Crasher.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    with pytest.raises(
+        (exceptions.WorkerCrashedError, exceptions.ActorDiedError)
+    ):
+        ray_tpu.get(a.crash.remote())
+    # The actor is now RESTARTING (or already restarted).  A call submitted
+    # here must queue transparently and resolve without a caller retry loop.
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+
+def test_large_arg_object_freed_after_task():
+    rt = _fresh(num_cpus=2)
+    try:
+        import numpy as np
+
+        @ray_tpu.remote
+        def consume(arr):
+            return int(arr.sum())
+
+        big = np.ones(512 * 1024, dtype=np.uint8)  # > inline threshold
+        assert ray_tpu.get(consume.remote(big)) == 512 * 1024
+        from ray_tpu.core.context import ctx
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            stats = ctx.client.call("store_stats")
+            if stats["num_objects"] == 0:
+                break
+            time.sleep(0.1)
+        assert stats["num_objects"] == 0, f"leaked args object: {stats}"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_placement_group_queues_until_feasible():
+    rt = _fresh(num_cpus=4)
+    try:
+        pg1 = ray_tpu.placement_group([{"CPU": 4}])
+        assert pg1.ready(timeout=5)
+        pg2 = ray_tpu.placement_group([{"CPU": 4}])  # busy: queues
+        assert not pg2.ready(timeout=0.3)
+        ray_tpu.remove_placement_group(pg1)
+        assert pg2.ready(timeout=10)
+        # Truly infeasible is rejected immediately.
+        with pytest.raises(RuntimeError, match="infeasible"):
+            ray_tpu.placement_group([{"CPU": 64}])
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_health_check_reaps_wedged_worker():
+    rt = _fresh(
+        num_cpus=2,
+        system_config={
+            "health_check_period_s": 0.2,
+            "health_check_failure_threshold": 3,
+            "default_task_max_retries": 0,
+        },
+    )
+    try:
+
+        @ray_tpu.remote(max_retries=0)
+        def wedge():
+            os.kill(os.getpid(), signal.SIGSTOP)  # freeze the whole process
+            return "unreachable"
+
+        with pytest.raises(exceptions.WorkerCrashedError):
+            ray_tpu.get(wedge.remote(), timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_idle_workers_reaped_and_respawned():
+    rt = _fresh(
+        num_cpus=2,
+        system_config={"idle_worker_killing_time_s": 0.5},
+    )
+    try:
+
+        @ray_tpu.remote
+        def f():
+            return os.getpid()
+
+        ray_tpu.get([f.remote() for _ in range(2)])
+        from ray_tpu.core.context import ctx
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            workers = ctx.client.call("list_state", {"kind": "workers"})["items"]
+            if not workers:
+                break
+            time.sleep(0.2)
+        assert not workers, f"idle workers not reaped: {workers}"
+        # Demand respawns the pool.
+        assert isinstance(ray_tpu.get(f.remote(), timeout=30), int)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_died_error_pickle_roundtrip():
+    err = exceptions.ActorDiedError("ab" * 16, "it crashed")
+    err2 = pickle.loads(pickle.dumps(err))
+    assert err2.actor_id_hex == "ab" * 16
+    assert err2.cause == "it crashed"
+    assert str(err2) == str(err)
+
+
+def test_collective_group_reinit_fresh_incarnation():
+    """Re-creating a collective group under the same name (elastic restart)
+    must not consume the previous incarnation's KV keys."""
+    rt = _fresh(num_cpus=4)
+    try:
+
+        @ray_tpu.remote
+        class Member:
+            def setup(self, world, rank, name):
+                from ray_tpu import collective
+
+                collective.init_collective_group(
+                    world, rank, group_name=name, timeout=30
+                )
+                return rank
+
+            def reduce(self, value):
+                import numpy as np
+
+                from ray_tpu import collective
+
+                return collective.allreduce(
+                    np.array([value], dtype=np.float64), group_name="elastic"
+                )[0]
+
+        for generation, (a_val, b_val) in enumerate([(1, 2), (10, 20)]):
+            m0, m1 = Member.remote(), Member.remote()
+            ray_tpu.get(
+                [m0.setup.remote(2, 0, "elastic"), m1.setup.remote(2, 1, "elastic")]
+            )
+            r0, r1 = ray_tpu.get(
+                [m0.reduce.remote(a_val), m1.reduce.remote(b_val)]
+            )
+            assert r0 == r1 == a_val + b_val, f"incarnation {generation}"
+            ray_tpu.kill(m0)
+            ray_tpu.kill(m1)
+            time.sleep(0.3)
+    finally:
+        ray_tpu.shutdown()
