@@ -1,0 +1,143 @@
+"""Multi-node test cluster on one machine.
+
+Role-equivalent to the reference's ray.cluster_utils.Cluster
+(reference: python/ray/cluster_utils.py:135 — multi-node without real
+machines by running one raylet per "node" on localhost): the head runs
+in-process via ray_tpu.init(); each added node is a real
+``ray_tpu.core.node_main`` daemon subprocess with its own store session,
+object-plane server, and worker pool.  remove_node() SIGKILLs the daemon to
+simulate node failure (workers are told to exit by the head on the daemon's
+disconnect).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.ids import NodeID
+
+
+class NodeHandle:
+    def __init__(self, node_id: NodeID, proc: subprocess.Popen, session: str):
+        self.node_id = node_id
+        self.proc = proc
+        self.session = session
+
+    @property
+    def hex(self) -> str:
+        return self.node_id.hex()
+
+
+class Cluster:
+    def __init__(
+        self,
+        head_num_cpus: int = 2,
+        head_resources: Optional[Dict[str, float]] = None,
+        system_config: Optional[dict] = None,
+    ):
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        ray_tpu.init(
+            num_cpus=head_num_cpus,
+            resources=head_resources,
+            system_config=system_config,
+        )
+        from ray_tpu.core.context import ctx
+
+        self.head_addr = os.environ["RT_ADDRESS"]
+        self.head_node_id: NodeID = ctx.client.node_id
+        self.nodes: List[NodeHandle] = []
+
+    def add_node(
+        self,
+        num_cpus: int = 2,
+        resources: Optional[Dict[str, float]] = None,
+        num_workers: Optional[int] = None,
+        labels: Optional[Dict[str, str]] = None,
+        timeout: float = 30.0,
+    ) -> NodeHandle:
+        node_id = NodeID.from_random()
+        session = f"node-{os.urandom(6).hex()}"
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus))
+        res.setdefault("memory", float(2**33))
+        env = dict(os.environ)
+        for k in list(env):
+            if k.startswith(("PALLAS_AXON", "TPU_", "AXON_")):
+                env.pop(k)
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_parent
+        )
+        env.update(
+            RT_HEAD_ADDR=self.head_addr,
+            RT_NODE_ID=node_id.hex(),
+            RT_NODE_SESSION=session,
+            RT_NODE_RESOURCES=json.dumps(res),
+            RT_NODE_LABELS=json.dumps(labels or {}),
+            RT_NODE_NUM_WORKERS=str(
+                num_workers if num_workers is not None else num_cpus
+            ),
+            JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+        )
+        log_dir = os.path.join("/tmp/ray_tpu_logs", session)
+        os.makedirs(log_dir, exist_ok=True)
+        logf = open(os.path.join(log_dir, "node-daemon.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_main"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+        )
+        logf.close()
+        handle = NodeHandle(node_id, proc, session)
+        self._wait_registered(node_id, timeout)
+        self.nodes.append(handle)
+        return handle
+
+    def _wait_registered(self, node_id: NodeID, timeout: float):
+        deadline = time.monotonic() + timeout
+        want = node_id.hex()
+        while time.monotonic() < deadline:
+            if any(n["node_id"] == want and n["alive"]
+                   for n in ray_tpu.nodes()):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"node {want[:12]} did not register in {timeout}s")
+
+    def remove_node(self, node: NodeHandle, graceful: bool = False):
+        """Kill a node daemon (SIGKILL = crash simulation).  The head notices
+        the disconnect, fails over its tasks/actors, and purges its object
+        locations."""
+        sig = signal.SIGTERM if graceful else signal.SIGKILL
+        try:
+            node.proc.send_signal(sig)
+        except ProcessLookupError:
+            pass
+        node.proc.wait(timeout=10)
+        deadline = time.monotonic() + 10
+        want = node.hex
+        while time.monotonic() < deadline:
+            if not any(n["node_id"] == want for n in ray_tpu.nodes()):
+                break
+            time.sleep(0.05)
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def shutdown(self):
+        for node in list(self.nodes):
+            try:
+                node.proc.kill()
+            except ProcessLookupError:
+                pass
+        self.nodes.clear()
+        ray_tpu.shutdown()

@@ -28,6 +28,7 @@ class Client:
         worker_id: Optional[bytes] = None,
         node_id: Optional[bytes] = None,
         pid: int = 0,
+        session: Optional[str] = None,
     ):
         host, port = head_addr.rsplit(":", 1)
         self.rpc = RpcClient(host, int(port), name=f"{kind}-rpc")
@@ -37,7 +38,10 @@ class Client:
         if node_id is not None:
             body["node_id"] = node_id
         reply = self.rpc.call("register", body)
-        self.session: str = reply["session"]
+        # Writes go under this process's *node* store session (worker
+        # processes on non-head nodes pass it in); the head session is the
+        # default for drivers/head-node processes.
+        self.session: str = session or reply["session"]
         self.node_id: Optional[NodeID] = (
             NodeID(node_id) if node_id else
             (NodeID(reply["node_id"]) if reply.get("node_id") else None)
@@ -46,6 +50,9 @@ class Client:
         self._stores: Dict[str, StoreClient] = {}
         self._sub_handlers: Dict[str, List[Callable]] = {}
         self._sub_lock = threading.Lock()
+        # Connections to other nodes' object-plane (pull) servers.
+        self._pull_conns: Dict[str, RpcClient] = {}
+        self._pull_lock = threading.Lock()
         self.rpc.on_push("pubsub", self._on_pubsub)
         self.rpc.on_push("object_free", self._on_object_free)
 
@@ -141,6 +148,14 @@ class Client:
             raise serialization.unpack(desc["error"])
         if desc.get("inline") is not None:
             return serialization.unpack(desc["inline"])
+        loc = desc.get("node_id")
+        if (loc is not None and self.node_id is not None
+                and loc != self.node_id.binary()):
+            # The object lives on another node: fetch it over that node's
+            # object-plane server into our local store (reference:
+            # object_manager.h:117 chunked pull + local plasma copy).
+            view = self._pull_remote(oid, desc)
+            return serialization.unpack(view)
         view = self.store(desc["session"]).get(oid, timeout=2.0)
         if view is None:
             # Segment may have been spilled to disk; ask the store daemon to
@@ -151,10 +166,71 @@ class Client:
                 view = self.store(desc["session"]).get(oid, timeout=2.0)
         if view is None:
             raise exceptions.ObjectLostError(
-                f"object {oid} location lost (node died?); "
-                "lineage reconstruction not available for this object"
+                f"object {oid} location lost (node died?)"
             )
         return serialization.unpack(view)
+
+    # -- inter-node transfer ---------------------------------------------------
+
+    def _pull_conn(self, addr: str) -> RpcClient:
+        with self._pull_lock:
+            conn = self._pull_conns.get(addr)
+            if conn is None or conn.closed:
+                host, port = addr.rsplit(":", 1)
+                conn = RpcClient(host, int(port), name="object-pull")
+                self._pull_conns[addr] = conn
+            return conn
+
+    def _pull_remote(self, oid: ObjectID, desc: dict) -> memoryview:
+        from .node_main import PULL_CHUNK_BYTES
+
+        addr = desc.get("addr")
+        if not addr:
+            raise exceptions.ObjectLostError(
+                f"object {oid}: owner node has no object-plane address"
+            )
+        local = self.store()
+        existing = local.get(oid)
+        if existing is not None:  # already pulled by this process earlier
+            return existing
+        size = desc["size"]
+        buf, commit, abort = local.create_staged(oid, size)
+        try:
+            rpc = self._pull_conn(addr)
+            off = 0
+            while off < size:
+                reply = rpc.call(
+                    "pull_object",
+                    {"object_id": oid.binary(), "offset": off,
+                     "max_bytes": PULL_CHUNK_BYTES},
+                    timeout=120.0,
+                )
+                if not reply.get("found"):
+                    raise exceptions.ObjectLostError(
+                        f"object {oid} vanished from {addr} mid-pull"
+                    )
+                data = reply["data"]
+                if not data:
+                    raise exceptions.ObjectLostError(
+                        f"object {oid}: empty chunk at offset {off} from {addr}"
+                    )
+                buf[off:off + len(data)] = data
+                off += len(data)
+        except Exception:
+            abort()
+            raise
+        view = commit()
+        # Register the new copy: same-node readers now attach via shm, and
+        # the node's store daemon takes accounting ownership.
+        try:
+            self.rpc.call(
+                "put_object",
+                {"object_id": oid.binary(), "size": size,
+                 "node_id": self.node_id.binary()},
+            )
+        except Exception:
+            pass
+        return view
 
     def wait(self, refs: Sequence, num_returns: int, timeout: float):
         with self._maybe_blocked():

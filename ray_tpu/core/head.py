@@ -477,6 +477,13 @@ class Head:
             for rec in self.objects.values():
                 rec.locations.discard(node_id)
             for w in [w for w in self.workers.values() if w.node_id == node_id]:
+                # The daemon is gone but its worker processes may still be
+                # alive (e.g. simulated node removal): tell them to exit.
+                if w.conn.alive:
+                    try:
+                        await w.conn.push("exit", {})
+                    except Exception:
+                        pass
                 await self._handle_worker_death(w.worker_id)
         for topic_subs in self.subs.values():
             topic_subs.discard(conn.conn_id)

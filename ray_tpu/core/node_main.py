@@ -96,17 +96,17 @@ class NodeDaemon:
             ) if self.node_id else None,
         )
         self.head.on_connection_lost = lambda: os._exit(0)
-        reply = self.head.call(
-            "register",
-            {
-                "kind": "node",
-                "resources": self.resources,
-                "labels": self.labels,
-                "num_workers": self.num_workers,
-                "store_session": self.session,
-                "object_addr": f"{self.host}:{port}",
-            },
-        )
+        body = {
+            "kind": "node",
+            "resources": self.resources,
+            "labels": self.labels,
+            "num_workers": self.num_workers,
+            "store_session": self.session,
+            "object_addr": f"{self.host}:{port}",
+        }
+        if os.environ.get("RT_NODE_ID"):  # pre-assigned (cluster_utils)
+            body["node_id"] = bytes.fromhex(os.environ["RT_NODE_ID"])
+        reply = self.head.call("register", body)
         self.node_id = NodeID(reply["node_id"])
 
     @staticmethod

@@ -50,6 +50,9 @@ class Worker:
             worker_id=self.worker_id,
             node_id=self.node_id,
             pid=os.getpid(),
+            # Object writes go under this worker's node store session (set
+            # by the node daemon / head spawner), not the head's.
+            session=os.environ.get("RT_SESSION"),
         )
         ctx.client = self.client
         ctx.mode = "worker"
