@@ -54,6 +54,10 @@ class Cluster:
         self.head_addr = os.environ["RT_ADDRESS"]
         self.head_node_id: NodeID = ctx.client.node_id
         self.nodes: List[NodeHandle] = []
+        # Every session this cluster ever created (including killed nodes,
+        # whose daemons died before they could clean /dev/shm) — swept on
+        # shutdown so crash-simulation tests don't leak segments.
+        self._sessions: List[str] = []
 
     def add_node(
         self,
@@ -100,6 +104,7 @@ class Cluster:
         )
         logf.close()
         handle = NodeHandle(node_id, proc, session)
+        self._sessions.append(session)
         self._wait_registered(node_id, timeout)
         self.nodes.append(handle)
         return handle
@@ -141,3 +146,15 @@ class Cluster:
                 pass
         self.nodes.clear()
         ray_tpu.shutdown()
+        # Sweep segments left by nodes that died without cleanup (SIGKILL
+        # crash simulation): the store daemon owns unlinking in normal
+        # operation, so anything still present belongs to a killed node.
+        import glob
+
+        for session in self._sessions:
+            for path in glob.glob(f"/dev/shm/rtpu-{session}-*"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        self._sessions.clear()

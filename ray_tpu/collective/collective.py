@@ -139,6 +139,10 @@ def init_collective_group(
 ) -> None:
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
+    if ":" in group_name:
+        # ':' is the KV namespace separator — a name containing it would
+        # misparse in the hello-key split during rendezvous.
+        raise ValueError(f"collective group name must not contain ':': {group_name!r}")
     if world_size == 1:
         _groups[group_name] = GroupState(1, 0, group_name, 0)
         return
@@ -176,12 +180,28 @@ def destroy_collective_group(group_name: str = "default") -> None:
         except Exception:
             pass
     _groups.pop(group_name, None)
-    if g.rank == 0:
+    c = _client()
+    if g.rank != 0:
+        # Ack that this rank is done reading the namespace; rank 0 must not
+        # sweep barrier keys a peer hasn't consumed yet (that would stall
+        # every peer's destroy for the full barrier timeout).
         try:
-            _del_prefix(g.ns + ":")
-            _del_prefix(f"col:{g.name}:hello:")
+            c.kv_put(f"{g.ns}:dack:{g.rank}", b"1")
         except Exception:
             pass
+        return
+    try:
+        if g.world_size > 1:
+            deadline = time.monotonic() + 5.0
+            want = {f"{g.ns}:dack:{r}" for r in range(1, g.world_size)}
+            while time.monotonic() < deadline:
+                if want <= set(c.kv_keys(f"{g.ns}:dack:")):
+                    break
+                time.sleep(_POLL_S)
+        _del_prefix(g.ns + ":")
+        _del_prefix(f"col:{g.name}:hello:")
+    except Exception:
+        pass
 
 
 def get_rank(group_name: str = "default") -> int:

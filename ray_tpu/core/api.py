@@ -40,6 +40,10 @@ class PlacementGroup:
         self.id = pg_id
         self.bundles = bundles
         self.strategy = strategy
+        # True when the bundles didn't fit the node set at creation time; the
+        # PG stays pending until nodes join (callers on fixed clusters can
+        # check this to fall back instead of blocking in ready()).
+        self.infeasible_now = False
 
     def ready(self, timeout: float = 30.0) -> bool:
         """Block until the group's bundles are reserved (False on timeout).
@@ -603,13 +607,22 @@ def placement_group(
             "name": name,
         },
     )
-    if reply.get("infeasible"):
-        raise RuntimeError(
-            f"placement group infeasible: bundles={bundles} strategy={strategy}"
-            " cannot fit even on an empty cluster"
+    pg = PlacementGroup(pg_id, bundles, strategy)
+    if reply.get("infeasible_now"):
+        # The reference keeps infeasible PGs pending so they are satisfied
+        # when nodes join later (gcs_placement_group_manager); warn rather
+        # than fail — ready() blocks until the cluster grows (or times out).
+        import warnings
+
+        pg.infeasible_now = True
+        warnings.warn(
+            f"placement group {pg_id.hex()[:8]} does not fit the current "
+            f"cluster (bundles={bundles} strategy={strategy}); it will stay "
+            "pending until nodes join",
+            stacklevel=2,
         )
     # created or queued: either way the handle is valid; ready() blocks.
-    return PlacementGroup(pg_id, bundles, strategy)
+    return pg
 
 
 def remove_placement_group(pg: PlacementGroup):

@@ -221,12 +221,15 @@ class Client:
             raise
         view = commit()
         # Register the new copy: same-node readers now attach via shm, and
-        # the node's store daemon takes accounting ownership.
+        # the node's store daemon takes accounting ownership.  `from_pull`
+        # lets the head reject (and reclaim) the copy if the object's last
+        # reference was dropped mid-pull — resurrecting a freed record would
+        # leak the segment with no owner left to decref it.
         try:
             self.rpc.call(
                 "put_object",
                 {"object_id": oid.binary(), "size": size,
-                 "node_id": self.node_id.binary()},
+                 "node_id": self.node_id.binary(), "from_pull": True},
             )
         except Exception:
             pass

@@ -51,6 +51,12 @@ class Config:
     # Idle task-workers older than this are reaped by the head's periodic
     # loop (reference: worker_pool.h idle worker killing).
     idle_worker_killing_time_s: float = 300.0
+    # Absolute ceiling on live workers per node, as a multiple of the pool
+    # cap.  Blocked workers (parked in nested ray.get) each permit one extra
+    # spawn so nested gets don't deadlock, but a deeply nested chain must not
+    # fork unboundedly (reference: worker_pool.h maximum_startup_concurrency
+    # bounds concurrent startup).
+    worker_pool_hard_cap_multiple: int = 4
     # -- fault tolerance ------------------------------------------------------
     default_task_max_retries: int = 3
     default_actor_max_restarts: int = 0

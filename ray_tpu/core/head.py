@@ -90,6 +90,12 @@ class TaskRecord:
         _task_seq += 1
         self.seq = _task_seq
         self.blocked = False
+        # Sticky placement: once the scheduler picks a node the task commits
+        # to it (resources held) and parks until a worker there frees up
+        # (reference: spread_scheduling_policy.h — the lease stays on the
+        # chosen raylet while its worker pool spins up a worker).
+        self.parked_node: Optional[NodeID] = None
+        self.park_time = 0.0
 
     @property
     def is_actor_task(self) -> bool:
@@ -170,6 +176,10 @@ class Head:
         self.objects: Dict[ObjectID, ObjectRecord] = {}
         self.object_waiters: Dict[ObjectID, List[asyncio.Event]] = {}
         self.queued_tasks: deque = deque()  # TaskRecords ready to schedule
+        # Tasks committed to a node (resources held), awaiting an idle worker.
+        self.node_parked: Dict[NodeID, deque] = {}
+        # PGs with bundles lost to node death, awaiting re-placement.
+        self.pgs_needing_bundles: Set[PlacementGroupID] = set()
         self.stream_items: Dict[tuple, dict] = {}  # (task_id, idx) -> item info
         self.stream_waiters: Dict[tuple, List[asyncio.Event]] = {}
         self.stream_done: Dict[TaskID, int] = {}  # total item count when finished
@@ -301,6 +311,18 @@ class Head:
                                 os.kill(w.pid, 9)
                             except (ProcessLookupError, PermissionError):
                                 pass
+                        else:
+                            # A wedged (e.g. SIGSTOP'd) process can't run its
+                            # connection-lost handler; its node daemon holds
+                            # the Popen handle and delivers the SIGKILL.
+                            daemon = self.node_daemons.get(w.node_id)
+                            if daemon is not None:
+                                try:
+                                    await daemon.push(
+                                        "kill_worker", {"pid": w.pid}
+                                    )
+                                except Exception:
+                                    pass
                         w.conn.writer.close()  # triggers _on_disconnect
                 # Node-daemon liveness (reference: GcsHealthCheckManager
                 # probes every raylet).
@@ -330,6 +352,24 @@ class Head:
                         times.popleft()
                         if self._spawn_pending.get(node_id, 0) > 0:
                             self._spawn_pending[node_id] -= 1
+                # Stale parked tasks: a node that can neither free nor spawn
+                # a worker within the register window gives the task back to
+                # the global queue (sticky placement must not become a
+                # deadlock when a node's pool is wedged).
+                stale_after = cfg.worker_register_timeout_s * 2
+                requeued = False
+                for node_id in list(self.node_parked):
+                    q = self.node_parked[node_id]
+                    for task in [
+                        t for t in q
+                        if t.state == PENDING
+                        and now - t.park_time > stale_after
+                    ]:
+                        self._unpark(task)
+                        self.queued_tasks.append(task)
+                        requeued = True
+                if requeued:
+                    self._kick()
             except asyncio.CancelledError:
                 return
             except Exception:
@@ -471,11 +511,27 @@ class Head:
             self.node_daemons.pop(node_id, None)
             self.node_object_addrs.pop(node_id, None)
             self.node_last_ack.pop(node_id, None)
-            self.scheduler.remove_node(node_id)
+            damaged = self.scheduler.remove_node(node_id)
+            if damaged:
+                # Bundles lost with the node get re-placed on survivors
+                # (reference: gcs_placement_group_scheduler.h reschedules on
+                # node death); until then tasks targeting them stay queued.
+                self.pgs_needing_bundles.update(damaged)
+            # Tasks committed to the dead node go back to the global queue
+            # (their resources died with the node — release is a no-op).
+            for task in self.node_parked.pop(node_id, ()):
+                if task.state == PENDING:
+                    task.parked_node = None
+                    self.queued_tasks.append(task)
             # Objects whose only copy lived there are gone; purge locations
             # so readers fail fast (lineage reconstruction can then kick in).
             for rec in self.objects.values():
                 rec.locations.discard(node_id)
+            # The dead node may have had zero registered workers (the sticky-
+            # placement case: parked task, worker still spawning) — the
+            # per-worker death path below won't run, so kick explicitly for
+            # the requeued tasks and lost-bundle rescheduling.
+            self._kick()
             for w in [w for w in self.workers.values() if w.node_id == node_id]:
                 # The daemon is gone but its worker processes may still be
                 # alive (e.g. simulated node removal): tell them to exit.
@@ -512,6 +568,24 @@ class Head:
     async def h_put_object(self, conn, body):
         """Driver/worker ray.put: object already written to shm (or inline)."""
         oid = ObjectID(body["object_id"])
+        if body.get("from_pull") and oid not in self.objects:
+            # The object's last reference was dropped mid-pull: registering
+            # the new copy would resurrect a freed record with no remaining
+            # owner.  Drop the copy instead: adopt it into its node's store
+            # (so the daemon owns the segment) and free it immediately.
+            node_id = NodeID(body["node_id"])
+            if node_id == self.local_node_id:
+                try:
+                    self.store.adopt(oid)
+                except (FileNotFoundError, MemoryError):
+                    pass
+                self.store.free(oid)
+            else:
+                daemon = self.node_daemons.get(node_id)
+                if daemon is not None:
+                    await daemon.push("adopt_object", {"object_id": oid.binary()})
+                    await daemon.push("free_objects", {"object_ids": [oid.binary()]})
+            return {"freed": True}
         rec = self._obj(oid)
         if body.get("inline") is not None:
             rec.inline = body["inline"]
@@ -557,6 +631,7 @@ class Head:
 
     async def h_free_objects(self, conn, body):
         freed = []
+        freed_locations: Set[NodeID] = set()
         for raw in body["object_ids"]:
             oid = ObjectID(raw)
             rec = self.objects.get(oid)
@@ -564,21 +639,36 @@ class Head:
                 continue
             rec.ref_count -= 1
             if rec.ref_count <= 0:
+                freed_locations.update(rec.locations)
                 self.objects.pop(oid, None)
                 self.store.free(oid)
                 freed.append(raw)
         if freed:
-            await self._broadcast_to_nodes("free_objects", {"object_ids": freed})
+            await self._broadcast_free(freed, freed_locations)
         return {"num_freed": len(freed)}
 
-    async def _broadcast_to_nodes(self, method, body):
-        for conn in list(self.node_daemons.values()):
-            try:
-                await conn.push(method, body)
-            except Exception:
-                pass
-        # The driver process frees local-node segments (see api.Client).
-        await self._publish("object_free", body)
+    async def _broadcast_free(self, freed: List[bytes],
+                              locations: Set[NodeID]):
+        """Tell the processes that could hold a copy to drop it: the store
+        daemons of the objects' location nodes unlink the segments, and
+        drivers/workers on those nodes detach (munmap) — clients install an
+        "object_free" push handler at connect (client.py).  Filtering by
+        location keeps the free path O(holders), not O(cluster)."""
+        body = {"object_ids": freed}
+        for node_id in locations:
+            daemon = self.node_daemons.get(node_id)
+            if daemon is not None:
+                try:
+                    await daemon.push("free_objects", body)
+                except Exception:
+                    pass
+        for c in list(self.server.connections.values()):
+            if (c.meta.get("kind") in ("driver", "worker")
+                    and c.meta.get("reader_node") in locations):
+                try:
+                    await c.push("object_free", body)
+                except Exception:
+                    pass
 
     def _object_wire(self, rec: ObjectRecord,
                      prefer: Optional[NodeID] = None) -> dict:
@@ -743,11 +833,20 @@ class Head:
         """Single dispatch pass: match queued tasks to idle workers.
 
         The analog of LocalTaskManager::ScheduleAndDispatchTasks
-        (reference: src/ray/raylet/local_task_manager.h:58)."""
+        (reference: src/ray/raylet/local_task_manager.h:58).  Placement is
+        *sticky*: once the scheduler picks a node the task acquires that
+        node's resources and parks in its per-node queue until a worker
+        there is idle — a warm node's workers must not drain the queue while
+        a cold node's workers are still starting (reference:
+        spread_scheduling_policy.h + local_task_manager.h keep the lease on
+        the chosen raylet while its worker pool spins up)."""
         if self._shutdown:
             return
+        if self.pgs_needing_bundles:
+            self._try_reschedule_bundles()
         if self.pending_pgs:
             self._try_pending_pgs()
+        await self._drain_parked()
         made_progress = True
         while made_progress and self.queued_tasks:
             made_progress = False
@@ -760,22 +859,71 @@ class Head:
                 if node_id is None:
                     requeue.append(task)
                     continue
+                if not self.scheduler.acquire(node_id, task.resources, task.strategy):
+                    requeue.append(task)
+                    continue
                 worker = self._find_idle_worker(node_id)
                 if worker is None:
-                    # Actors get dedicated processes beyond the task-worker
-                    # cap; plain tasks respect the cap.
+                    # Commit to the picked node: hold the resources, park
+                    # until a worker registers or frees up there.  Actors get
+                    # dedicated processes beyond the task-worker cap; plain
+                    # tasks respect the cap.
                     self._maybe_spawn(
                         node_id,
                         force=bool(task.spec.get("is_actor_creation")),
                     )
-                    requeue.append(task)
-                    continue
-                if not self.scheduler.acquire(node_id, task.resources, task.strategy):
-                    requeue.append(task)
+                    task.parked_node = node_id
+                    task.park_time = time.monotonic()
+                    self.node_parked.setdefault(node_id, deque()).append(task)
+                    made_progress = True  # resource state changed
                     continue
                 await self._dispatch(task, worker)
                 made_progress = True
             self.queued_tasks.extend(requeue)
+
+    async def _drain_parked(self):
+        """Dispatch node-committed tasks to workers that have become idle.
+        Resources were acquired at park time — no re-acquire here."""
+        for node_id in list(self.node_parked):
+            q = self.node_parked.get(node_id)
+            while q:
+                task = q[0]
+                if task.state != PENDING:
+                    q.popleft()
+                    continue
+                worker = self._find_idle_worker(node_id)
+                if worker is None:
+                    self._maybe_spawn(
+                        node_id,
+                        force=bool(task.spec.get("is_actor_creation")),
+                    )
+                    break
+                q.popleft()
+                task.parked_node = None
+                await self._dispatch(task, worker)
+            if not q:
+                self.node_parked.pop(node_id, None)
+
+    def _unpark(self, task: TaskRecord, release: bool = True):
+        """Pull a task out of its node's parked queue (cancable/stale paths),
+        optionally releasing the committed resources."""
+        node_id = task.parked_node
+        if node_id is None:
+            return
+        task.parked_node = None
+        q = self.node_parked.get(node_id)
+        if q is not None:
+            try:
+                q.remove(task)
+            except ValueError:
+                pass
+        if release:
+            self.scheduler.release(node_id, task.resources, task.strategy)
+
+    def _try_reschedule_bundles(self):
+        for pg_id in list(self.pgs_needing_bundles):
+            if self.scheduler.reschedule_lost_bundles(pg_id):
+                self.pgs_needing_bundles.discard(pg_id)
 
     def _find_idle_worker(self, node_id: NodeID) -> Optional[WorkerState]:
         for w in self.workers.values():
@@ -787,12 +935,22 @@ class Head:
         cap = self.node_worker_caps.get(node_id, 0)
         # Actor-dedicated workers don't count against the task-worker pool cap
         # (reference: worker_pool.h tracks dedicated vs shared workers).
-        count = sum(
-            1
-            for w in self.workers.values()
-            if w.node_id == node_id and w.state in (STARTING, IDLE, LEASED)
-        )
+        count = 0
+        blocked = 0
+        for w in self.workers.values():
+            if w.node_id != node_id:
+                continue
+            if w.state in (STARTING, IDLE, LEASED):
+                count += 1
+            elif w.state == BLOCKED:
+                blocked += 1
         pending = self._spawn_pending.get(node_id, 0)
+        # Blocked workers each permit one extra pool slot (their task's
+        # resources were released), but total live processes are hard-capped
+        # so a deeply nested get chain can't fork without bound.
+        hard_cap = max(cap, 1) * self.config.worker_pool_hard_cap_multiple
+        if count + blocked + pending >= hard_cap:
+            return
         if count + pending < cap or (force and pending == 0):
             self._spawn_worker(node_id)
 
@@ -1025,6 +1183,7 @@ class Head:
                 self.queued_tasks.remove(task)
             except ValueError:
                 pass
+            self._unpark(task)  # releases node-committed resources, if any
             self._finalize_task(task)
             return {"cancelled": True}
         if task.state == RUNNING and task.worker_id:
@@ -1304,18 +1463,20 @@ class Head:
     async def h_create_placement_group(self, conn, body):
         pg_id = PlacementGroupID(body["pg_id"])
         strategy = body.get("strategy", "PACK")
-        if not self.scheduler.check_feasible_ever(body["bundles"], strategy):
-            return {"created": False, "infeasible": True}
         ok = self.scheduler.create_placement_group(
             pg_id, body["bundles"], strategy, body.get("name", "")
         )
         if ok:
             self._notify_pg_ready(pg_id)
-        else:
-            # Feasible but resources are busy: queue until they free up
-            # (reference: gcs_placement_group_manager pending queue).
-            self.pending_pgs[pg_id] = body
-        return {"created": ok, "queued": not ok}
+            return {"created": True}
+        # Not placeable right now — either resources are busy or the bundles
+        # don't fit the current node set at all.  Both queue (reference:
+        # gcs_placement_group_manager keeps infeasible PGs pending so they
+        # are satisfied when nodes join later); `infeasible_now` lets the
+        # client warn that ready() will block until the cluster grows.
+        feasible = self.scheduler.check_feasible_ever(body["bundles"], strategy)
+        self.pending_pgs[pg_id] = body
+        return {"created": False, "queued": True, "infeasible_now": not feasible}
 
     def _notify_pg_ready(self, pg_id: PlacementGroupID):
         for ev in self.pg_waiters.pop(pg_id, []):

@@ -86,6 +86,7 @@ class NodeDaemon:
             *self._split(self.head_addr), name="node-daemon-rpc"
         )
         self.head.on_push("spawn_worker", self._on_spawn_worker)
+        self.head.on_push("kill_worker", self._on_kill_worker)
         self.head.on_push("free_objects", self._on_free_objects)
         self.head.on_push("adopt_object", self._on_adopt_object)
         self.head.on_push("shutdown", lambda b: self._shutdown.set())
@@ -148,6 +149,18 @@ class NodeDaemon:
         )
         logf.close()
         self.worker_procs.append(proc)
+
+    def _on_kill_worker(self, body):
+        """SIGKILL a wedged local worker on the head's behalf — a stopped
+        process can't run its connection-lost handler, so the daemon (which
+        holds the Popen handle) must deliver the signal (reference: raylet
+        DestroyWorker kills local worker processes)."""
+        pid = body.get("pid")
+        if pid and any(p.pid == pid for p in self.worker_procs):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
 
     def _on_free_objects(self, body):
         for raw in body.get("object_ids", []):

@@ -124,14 +124,65 @@ class ClusterScheduler:
         self.nodes[node_id] = node
         return node
 
-    def remove_node(self, node_id: NodeID) -> None:
+    def remove_node(self, node_id: NodeID) -> List[PlacementGroupID]:
+        """Drop a node.  Returns ids of placement groups that lost bundles
+        (the control plane retries `reschedule_lost_bundles` for them —
+        reference: gcs_placement_group_scheduler.h reschedules bundles on
+        node death)."""
         node = self.nodes.pop(node_id, None)
         if node is None:
-            return
+            return []
+        damaged: List[PlacementGroupID] = []
         for pg in self.placement_groups.values():
             for b in pg.bundles:
                 if b.node_id == node_id:
                     b.node_id = None  # bundle lost; pg needs reschedule
+                    if pg.pg_id not in damaged:
+                        damaged.append(pg.pg_id)
+        return damaged
+
+    def reschedule_lost_bundles(self, pg_id: PlacementGroupID) -> bool:
+        """Re-place bundles whose node died.  Returns True when the PG is
+        whole again (all bundles placed); False to retry later.  Placement
+        honors the PG strategy: STRICT_SPREAD avoids nodes holding sibling
+        bundles, STRICT_PACK co-locates with survivors (or re-packs from
+        scratch when every bundle was lost)."""
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return True  # removed meanwhile
+        lost = [b for b in pg.bundles if b.node_id is None]
+        if not lost:
+            return True
+        used = {b.node_id for b in pg.bundles if b.node_id is not None}
+        placed: List[Tuple[Bundle, NodeID]] = []
+        avail = {
+            nid: dict(n.available) for nid, n in self.nodes.items() if n.alive
+        }
+        for b in lost:
+            order = sorted(
+                avail,
+                key=lambda nid: (self.nodes[nid].utilization(), nid),
+            )
+            chosen = None
+            for nid in order:
+                if pg.strategy == PlacementStrategy.STRICT_SPREAD and nid in used:
+                    continue
+                if (pg.strategy == PlacementStrategy.STRICT_PACK and used
+                        and nid not in used):
+                    continue
+                if _fits(avail[nid], b.resources):
+                    chosen = nid
+                    break
+            if chosen is None:
+                return False  # all-or-nothing: retry when resources free up
+            _sub(avail[chosen], b.resources)
+            used.add(chosen)
+            placed.append((b, chosen))
+        for b, nid in placed:
+            b.node_id = nid
+            b.available = dict(b.resources)
+            _sub(self.nodes[nid].available, b.resources)
+        return True
 
     # -- task/actor placement -------------------------------------------------
 

@@ -94,13 +94,20 @@ class WorkerGroup:
         self.trial_dir = trial_dir
         self.pg = None
         if num_workers > 1:
-            try:
-                self.pg = ray_tpu.placement_group(
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                pg = ray_tpu.placement_group(
                     [dict(resources_per_worker) for _ in range(num_workers)],
                     strategy=placement_strategy,
                 )
-            except RuntimeError:
-                self.pg = None  # infeasible bundles: fall back to best-effort
+            if pg.infeasible_now:
+                # Bundles don't fit this cluster: a pending PG would park the
+                # whole gang forever — drop it and schedule best-effort.
+                ray_tpu.remove_placement_group(pg)
+            else:
+                self.pg = pg
         opts: Dict[str, Any] = {"num_cpus": resources_per_worker.get("CPU", 1)}
         if resources_per_worker.get("TPU"):
             opts["num_tpus"] = resources_per_worker["TPU"]

@@ -144,3 +144,39 @@ def test_object_lost_when_sole_copy_node_dies(cluster):
     cluster.remove_node(n1)
     with pytest.raises(exceptions.ObjectLostError):
         ray_tpu.get(ref, timeout=30)
+
+
+def test_placement_group_bundle_replaced_on_node_death(cluster):
+    """Bundles lost with a node are re-placed on survivors (reference:
+    gcs_placement_group_scheduler.h reschedules bundles on node death)."""
+    n1 = cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    # Find which node holds bundle 1 and kill it.
+    from ray_tpu.core.context import ctx
+
+    pgs = ctx.client.call("list_state", {"kind": "placement_groups"})["items"]
+    holders = [b["node"] for b in pgs[0]["bundles"]]
+    victim = n1 if n1.hex in holders else n2
+    cluster.remove_node(victim)
+    # A task targeting the PG must run once the lost bundle is re-placed.
+    ref = where.options(
+        scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=holders.index(victim.hex)
+        )
+    ).remote()
+    assert ray_tpu.get(ref, timeout=60) != victim.hex
+
+
+def test_placement_group_pending_until_node_joins(cluster):
+    """A PG too big for the current cluster queues and becomes ready when a
+    node joins (reference: gcs_placement_group_manager pending queue)."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pg = ray_tpu.placement_group([{"CPU": 4}])
+    assert not pg.ready(timeout=0.3)
+    cluster.add_node(num_cpus=4)
+    assert pg.ready(timeout=30)
