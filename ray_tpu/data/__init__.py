@@ -1,0 +1,40 @@
+"""ray_tpu.data — streaming dataset library (Ray Data equivalent).
+
+Role-equivalent to the reference's Ray Data (reference:
+python/ray/data/dataset.py:139 Dataset API;
+data/_internal/execution/streaming_executor.py:48 pull-based streaming
+execution; data/_internal/execution/operators/output_splitter.py
+streaming_split feeding Train workers).  TPU-first design choices:
+
+- Blocks are Arrow tables at rest and dict-of-numpy batches in flight — the
+  batch format `jax.device_put` consumes directly (reference keeps Arrow /
+  pandas blocks and converts per-batch, data/block.py:221 BlockAccessor).
+- Execution is a bounded-window pull pipeline of remote tasks over the
+  cluster; `iter_batches` double-buffers `jax.device_put` so the TPU never
+  waits on host→HBM transfer (the "Arrow→TPU pipeline" north star).
+- `streaming_split(n)` hands blocks to n consumers through a coordinator
+  actor (the OutputSplitter analog) so Train workers across nodes each pull
+  a disjoint stream.
+"""
+
+from .block import Block
+from .context import DataContext
+from .dataset import (
+    Dataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A001  (shadows builtins.range on purpose, like the reference)
+    range_tensor,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+from .iterator import DataIterator
+
+__all__ = [
+    "Block", "DataContext", "Dataset", "DataIterator",
+    "from_arrow", "from_items", "from_numpy", "from_pandas",
+    "range", "range_tensor", "read_csv", "read_json", "read_parquet",
+]

@@ -1,0 +1,33 @@
+"""Per-process dataset execution configuration.
+
+Role-equivalent to the reference's DataContext (reference:
+python/ray/data/context.py) — a process-wide singleton consulted at plan
+execution time, deliberately small: the streaming executor here has two
+tunables (task window, default block count) instead of the reference's
+several dozen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class DataContext:
+    # Max dataset tasks in flight per execution (pull-based backpressure —
+    # reference: streaming_executor_state.py select_operator_to_run caps
+    # concurrent tasks by resource budget).
+    execution_window: int = 16
+    # Default number of blocks for sources that don't specify parallelism
+    # (reference: DataContext.min_parallelism / target block sizing).
+    default_num_blocks: int = 8
+    # Rows per batch when iter_batches is not given a batch_size.
+    default_batch_size: int = 256
+
+    _current = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        if DataContext._current is None:
+            DataContext._current = DataContext()
+        return DataContext._current

@@ -1,0 +1,648 @@
+"""Dataset: lazy plan + bounded-window streaming execution over the cluster.
+
+Role-equivalent to the reference's Dataset / streaming executor (reference:
+python/ray/data/dataset.py:139 — map_batches:383, repartition:1042,
+split:1337, iter_batches:3675, streaming_split via
+data/_internal/execution/operators/output_splitter.py;
+data/_internal/execution/streaming_executor.py:48).  Design deviation: the
+reference builds logical→physical plans with an optimizer and a
+resource-budgeted operator state machine; here the plan is a list of
+(source, op-chain) parts and execution is a pull-based window of remote
+tasks — each task runs the whole chain for one block (operator fusion by
+construction, which is what the reference's optimizer does to map chains
+anyway).
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+import ray_tpu
+
+from .block import Batch, Block
+from .context import DataContext
+
+# A part is one block's production recipe: a source (callable returning a
+# Block, or an ObjectRef of a materialized Block) plus the op chain to apply.
+Source = Any
+Op = Callable[[Block], Block]
+
+
+@ray_tpu.remote
+def _exec_part(source: Source, ops: List[Op]) -> Block:
+    block = source() if callable(source) else source
+    for op in ops:
+        block = op(block)
+    return block
+
+
+@ray_tpu.remote
+def _part_rows(source: Source, ops: List[Op]) -> int:
+    block = source() if callable(source) else source
+    for op in ops:
+        block = op(block)
+    return block.num_rows
+
+
+@ray_tpu.remote
+def _part_agg(source: Source, ops: List[Op], col: str, kind: str):
+    block = source() if callable(source) else source
+    for op in ops:
+        block = op(block)
+    if block.num_rows == 0:
+        return None
+    arr = block.to_numpy()[col]
+    if kind == "sum":
+        return (arr.sum(), len(arr))
+    if kind == "min":
+        return (arr.min(), len(arr))
+    if kind == "max":
+        return (arr.max(), len(arr))
+    raise ValueError(kind)
+
+
+@ray_tpu.remote
+def _gather_spans(spans: List[tuple]) -> Block:
+    """Concatenate row spans [(block_ref, lo, hi), ...] into one block.
+    Workers pull the referenced blocks (cross-node via the object plane)."""
+    pieces = []
+    for ref, lo, hi in spans:
+        block = ray_tpu.get(ref)
+        pieces.append(block.slice(lo, hi))
+    return Block.concat(pieces)
+
+
+@ray_tpu.remote
+def _gather_indices(parts: List[tuple]) -> Block:
+    """Concatenate fancy-indexed selections [(block_ref, indices), ...]."""
+    pieces = []
+    for ref, idx in parts:
+        block = ray_tpu.get(ref)
+        pieces.append(block.take_rows(np.asarray(idx)))
+    return Block.concat(pieces)
+
+
+@ray_tpu.remote
+def _write_parquet_task(source: Source, ops: List[Op], path: str) -> int:
+    import pyarrow.parquet as pq
+
+    block = source() if callable(source) else source
+    for op in ops:
+        block = op(block)
+    pq.write_table(block.to_arrow(), path)
+    return block.num_rows
+
+
+def _batch_op(fn, batch_format: str, fn_kwargs: Optional[dict]) -> Op:
+    kwargs = fn_kwargs or {}
+
+    def op(block: Block) -> Block:
+        if batch_format == "numpy":
+            out = fn(block.to_numpy(), **kwargs)
+        elif batch_format == "pandas":
+            out = fn(block.to_pandas(), **kwargs)
+        elif batch_format == "pyarrow":
+            out = fn(block.to_arrow(), **kwargs)
+        else:
+            raise ValueError(f"unknown batch_format {batch_format!r}")
+        if isinstance(out, Block):
+            return out
+        if isinstance(out, dict):
+            return Block.from_batch(out)
+        try:
+            import pandas as pd
+
+            if isinstance(out, pd.DataFrame):
+                return Block.from_batch(
+                    {c: out[c].to_numpy() for c in out.columns}
+                )
+        except ImportError:
+            pass
+        import pyarrow as pa
+
+        if isinstance(out, pa.Table):
+            return Block.from_arrow(out)
+        raise TypeError(
+            f"map_batches fn must return dict/DataFrame/Table, got {type(out)}"
+        )
+
+    return op
+
+
+class Dataset:
+    """Lazy, immutable dataset of blocks distributed over the cluster."""
+
+    def __init__(self, parts: List[tuple],
+                 counts: Optional[List[int]] = None):
+        self._parts = parts  # [(source, [op, ...]), ...]
+        self._counts = counts  # per-part row counts, when known
+
+    # ---------------------------------------------------------- transforms
+
+    def _with_op(self, op: Op) -> "Dataset":
+        return Dataset([(src, ops + [op]) for src, ops in self._parts])
+
+    def map_batches(
+        self,
+        fn: Callable[..., Union[Batch, Any]],
+        *,
+        batch_format: str = "numpy",
+        fn_kwargs: Optional[dict] = None,
+        batch_size: Optional[int] = None,  # accepted for API parity; the
+        # whole block is one batch (tasks already bound block sizes)
+    ) -> "Dataset":
+        """Apply fn to batches (reference: dataset.py map_batches:383)."""
+        return self._with_op(_batch_op(fn, batch_format, fn_kwargs))
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        def op(block: Block) -> Block:
+            return Block.from_items([fn(row) for row in block.rows()])
+
+        return self._with_op(op)
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def op(block: Block) -> Block:
+            rows: List[Dict] = []
+            for row in block.rows():
+                rows.extend(fn(row))
+            return Block.from_items(rows) if rows else Block({})
+
+        return self._with_op(op)
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def op(block: Block) -> Block:
+            batch = block.to_numpy()
+            keep = np.fromiter(
+                (bool(fn(row)) for row in block.rows()), dtype=bool,
+                count=block.num_rows,
+            )
+            return Block({k: v[keep] for k, v in batch.items()})
+
+        return self._with_op(op)
+
+    def select_columns(self, columns: Sequence[str]) -> "Dataset":
+        return self._with_op(lambda b: b.select(columns))
+
+    def add_column(self, name: str, fn: Callable[[Batch], np.ndarray]) -> "Dataset":
+        def op(block: Block) -> Block:
+            batch = block.to_numpy()
+            batch[name] = np.asarray(fn(batch))
+            return Block.from_batch(batch)
+
+        return self._with_op(op)
+
+    def drop_columns(self, columns: Sequence[str]) -> "Dataset":
+        drop = set(columns)
+
+        def op(block: Block) -> Block:
+            return block.select([c for c in block.columns() if c not in drop])
+
+        return self._with_op(op)
+
+    # ------------------------------------------------------- reorganization
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Rebalance into exactly num_blocks equal-ish blocks (reference:
+        dataset.py repartition:1042).  Materializes, then one gather task
+        per output block pulls just the row spans it needs."""
+        refs, counts = self._materialize_refs()
+        total = sum(counts)
+        bounds = [total * i // num_blocks for i in builtins.range(num_blocks + 1)]
+        # Prefix sums map global row ranges onto (block, local range) spans.
+        starts = np.cumsum([0] + counts)
+        parts: List[tuple] = []
+        out_counts: List[int] = []
+        for j in builtins.range(num_blocks):
+            lo, hi = bounds[j], bounds[j + 1]
+            spans = []
+            for i, ref in enumerate(refs):
+                blo, bhi = starts[i], starts[i + 1]
+                s, e = max(lo, blo), min(hi, bhi)
+                if s < e:
+                    spans.append((ref, int(s - blo), int(e - blo)))
+            parts.append((_gather_spans.remote(spans), []))
+            out_counts.append(hi - lo)
+        return Dataset(parts, out_counts)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """Global row shuffle (reference: dataset.py random_shuffle).  Each
+        output block takes a uniformly random subset of all rows; within an
+        output block rows stay grouped by source block (one gather per
+        source) — uniform assignment, locally clustered order."""
+        refs, counts = self._materialize_refs()
+        total = sum(counts)
+        n_out = max(len(refs), 1)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(total)
+        starts = np.cumsum([0] + counts)
+        bounds = [total * i // n_out for i in builtins.range(n_out + 1)]
+        parts: List[tuple] = []
+        out_counts: List[int] = []
+        for j in builtins.range(n_out):
+            mine = perm[bounds[j]:bounds[j + 1]]
+            pieces = []
+            for i, ref in enumerate(refs):
+                local = mine[(mine >= starts[i]) & (mine < starts[i + 1])]
+                if len(local):
+                    sel = (local - starts[i]).astype(np.int64)
+                    rng.shuffle(sel)
+                    pieces.append((ref, sel))
+            parts.append((_gather_indices.remote(pieces), []))
+            out_counts.append(len(mine))
+        return Dataset(parts, out_counts)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Total order by one column.  Single-task sort (no range
+        partitioning yet — reference uses a sample+shuffle exchange,
+        planner/exchange/sort_task_spec.py); fine for datasets that fit one
+        worker."""
+        refs, counts = self._materialize_refs()
+
+        @ray_tpu.remote
+        def _sort_all(refs: List[Any]) -> Block:
+            block = Block.concat([ray_tpu.get(r) for r in refs])
+            arr = block.to_numpy()[key]
+            order = np.argsort(arr, kind="stable")
+            if descending:
+                order = order[::-1]
+            return block.take_rows(order)
+
+        return Dataset([(_sort_all.remote(refs), [])], [sum(counts)])
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self._parts + other._parts)
+
+    def limit(self, k: int) -> "Dataset":
+        """First k rows (streams only as many parts as needed)."""
+        taken: List[tuple] = []
+        counts: List[int] = []
+        remaining = k
+        for ref in self._iter_block_refs():
+            if remaining <= 0:
+                break
+            block = ray_tpu.get(ref)
+            n = block.num_rows
+            if n <= remaining:
+                taken.append((ref, []))
+                counts.append(n)
+                remaining -= n
+            else:
+                taken.append((ray_tpu.put(block.slice(0, remaining)), []))
+                counts.append(remaining)
+                remaining = 0
+        return Dataset(taken, counts)
+
+    # ------------------------------------------------------------ execution
+
+    def _iter_block_refs(self, window: Optional[int] = None) -> Iterator[Any]:
+        """Launch part tasks with a bounded in-flight window, yielding block
+        refs in plan order (the pull-based streaming executor: the consumer's
+        pace bounds cluster work — reference: streaming_executor.py:48)."""
+        window = window or DataContext.get_current().execution_window
+        pending: deque = deque()
+        for src, ops in self._parts:
+            if not ops and not callable(src):
+                # Already-materialized block: no task needed.
+                pending.append(src)
+            else:
+                pending.append(_exec_part.remote(src, ops))
+            if len(pending) >= window:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self._iter_block_refs():
+            yield ray_tpu.get(ref)
+
+    def _materialize_refs(self) -> tuple:
+        refs = list(self._iter_block_refs())
+        if self._counts is not None and builtins.all(
+            not ops and not callable(src) for src, ops in self._parts
+        ):
+            return refs, list(self._counts)
+        counts = ray_tpu.get(
+            [_part_rows.remote(ref, []) for ref in refs]
+        )
+        return refs, counts
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan; the result holds materialized block refs
+        (reference: dataset.py materialize:4622)."""
+        refs, counts = self._materialize_refs()
+        return Dataset([(r, []) for r in refs], counts)
+
+    # ---------------------------------------------------------- consumption
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        prefetch_blocks: int = 2,
+        device: Any = None,
+    ) -> Iterator[Any]:
+        """Stream batches (reference: dataset.py iter_batches:3675).  With
+        ``device=`` each batch is jax.device_put ahead of consumption
+        (double buffering — the iter_torch_batches analog for TPU)."""
+        from .iterator import batches_from_blocks, device_prefetch
+
+        def blocks() -> Iterator[Block]:
+            refs: deque = deque()
+            it = self._iter_block_refs()
+            for ref in it:
+                refs.append(ref)
+                if len(refs) > prefetch_blocks:
+                    yield ray_tpu.get(refs.popleft())
+            while refs:
+                yield ray_tpu.get(refs.popleft())
+
+        batch_size = batch_size or DataContext.get_current().default_batch_size
+        out = batches_from_blocks(
+            blocks(), batch_size, batch_format, drop_last
+        )
+        if device is not None:
+            out = device_prefetch(out, device)
+        return out
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = None,
+                           drop_last: bool = False) -> Iterator[Dict]:
+        """CPU-torch batches (reference: dataset.py iter_torch_batches:3746)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            yield {k: torch.from_numpy(np.ascontiguousarray(v))
+                   for k, v in batch.items()}
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for block in self.iter_blocks():
+            yield from block.rows()
+
+    def take(self, k: int = 20) -> List[Dict]:
+        out: List[Dict] = []
+        for block in self.iter_blocks():
+            for row in block.rows():
+                out.append(row)
+                if len(out) >= k:
+                    return out
+        return out
+
+    def take_all(self) -> List[Dict]:
+        out: List[Dict] = []
+        for block in self.iter_blocks():
+            out.extend(block.rows())
+        return out
+
+    def count(self) -> int:
+        if self._counts is not None:
+            return sum(self._counts)
+        return sum(ray_tpu.get(
+            [_part_rows.remote(src, ops) for src, ops in self._parts]
+        ))
+
+    def schema(self) -> Dict[str, str]:
+        for block in self.iter_blocks():
+            if block.num_rows:
+                return block.schema()
+        return {}
+
+    def columns(self) -> List[str]:
+        return list(self.schema())
+
+    def _agg(self, col: str, kind: str):
+        partials = [p for p in ray_tpu.get(
+            [_part_agg.remote(src, ops, col, kind)
+             for src, ops in self._parts]
+        ) if p is not None]
+        if not partials:
+            return None
+        vals = [v for v, _ in partials]
+        if kind == "sum":
+            return sum(vals)
+        return min(vals) if kind == "min" else max(vals)
+
+    def sum(self, col: str):
+        return self._agg(col, "sum")
+
+    def min(self, col: str):
+        return self._agg(col, "min")
+
+    def max(self, col: str):
+        return self._agg(col, "max")
+
+    def mean(self, col: str):
+        partials = [p for p in ray_tpu.get(
+            [_part_agg.remote(src, ops, col, "sum")
+             for src, ops in self._parts]
+        ) if p is not None]
+        total = sum(v for v, _ in partials)
+        n = sum(c for _, c in partials)
+        return total / n if n else None
+
+    # ------------------------------------------------------------- splitting
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Materialize and split into n disjoint datasets, blocks assigned
+        round-robin (reference: dataset.py split:1337)."""
+        refs, counts = self._materialize_refs()
+        out = []
+        for i in builtins.range(n):
+            mine = [(refs[j], []) for j in builtins.range(i, len(refs), n)]
+            mine_counts = [counts[j] for j in builtins.range(i, len(refs), n)]
+            out.append(Dataset(mine, mine_counts))
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """n coordinated iterators over disjoint streams of this dataset —
+        the Train ingest path (reference: output_splitter.py OutputSplitter,
+        dataset.py streaming_split).  `equal`/`locality_hints` accepted for
+        API parity; blocks are handed out round-robin on demand."""
+        from .split import make_split_iterators
+
+        return make_split_iterators(self, n)
+
+    # ---------------------------------------------------------------- output
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        ray_tpu.get([
+            _write_parquet_task.remote(
+                src, ops, os.path.join(path, f"part-{i:05d}.parquet")
+            )
+            for i, (src, ops) in enumerate(self._parts)
+        ])
+
+    def num_blocks(self) -> int:
+        return len(self._parts)
+
+    def __repr__(self) -> str:
+        return (
+            f"Dataset(num_blocks={len(self._parts)}, "
+            f"count={sum(self._counts) if self._counts is not None else '?'})"
+        )
+
+
+# ------------------------------------------------------------------ sources
+
+
+def from_items(items: Sequence[Any], *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    n = override_num_blocks or min(
+        DataContext.get_current().default_num_blocks, max(len(items), 1)
+    )
+    parts = []
+    counts = []
+    for i in builtins.range(n):
+        chunk = items[len(items) * i // n: len(items) * (i + 1) // n]
+        if not chunk:
+            continue
+        parts.append((functools.partial(Block.from_items, list(chunk)), []))
+        counts.append(len(chunk))
+    return Dataset(parts, counts)
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    nb = override_num_blocks or DataContext.get_current().default_num_blocks
+
+    def make(lo: int, hi: int) -> Block:
+        return Block({"id": np.arange(lo, hi, dtype=np.int64)})
+
+    parts = []
+    counts = []
+    for i in builtins.range(nb):
+        lo, hi = n * i // nb, n * (i + 1) // nb
+        if lo < hi:
+            parts.append((functools.partial(make, lo, hi), []))
+            counts.append(hi - lo)
+    return Dataset(parts, counts)
+
+
+def range_tensor(n: int, *, shape: Sequence[int] = (1,),
+                 override_num_blocks: Optional[int] = None) -> Dataset:
+    nb = override_num_blocks or DataContext.get_current().default_num_blocks
+    shape = tuple(shape)
+
+    def make(lo: int, hi: int) -> Block:
+        ids = np.arange(lo, hi, dtype=np.int64)
+        data = np.broadcast_to(
+            ids.reshape((-1,) + (1,) * len(shape)), (hi - lo,) + shape
+        ).copy()
+        return Block({"data": data})
+
+    parts = []
+    counts = []
+    for i in builtins.range(nb):
+        lo, hi = n * i // nb, n * (i + 1) // nb
+        if lo < hi:
+            parts.append((functools.partial(make, lo, hi), []))
+            counts.append(hi - lo)
+    return Dataset(parts, counts)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data", *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    nb = override_num_blocks or DataContext.get_current().default_num_blocks
+    parts = []
+    counts = []
+    for i in builtins.range(nb):
+        lo, hi = len(arr) * i // nb, len(arr) * (i + 1) // nb
+        if lo < hi:
+            chunk = arr[lo:hi].copy()
+            parts.append((functools.partial(Block.from_batch, {column: chunk}), []))
+            counts.append(hi - lo)
+    return Dataset(parts, counts)
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([(functools.partial(Block.from_arrow, table), [])],
+                   [table.num_rows])
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset(
+        [(functools.partial(
+            Block.from_batch, {c: df[c].to_numpy() for c in df.columns}), [])],
+        [len(df)],
+    )
+
+
+def _expand_paths(paths: Union[str, Sequence[str]], suffixes) -> List[str]:
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                os.path.join(p, f) for f in sorted(os.listdir(p))
+                if f.endswith(suffixes)
+            )
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files under {paths}")
+    return out
+
+
+def _read_source(files: List[str], reader: Callable[[str], Block],
+                 override_num_blocks: Optional[int]) -> Dataset:
+    """One read task per file (reference: read_api.py splits files across
+    read tasks; per-file granularity is the common case)."""
+
+    def read_many(fs: List[str]) -> Block:
+        return Block.concat([reader(f) for f in fs])
+
+    n = override_num_blocks or len(files)
+    n = min(n, len(files))
+    parts = []
+    for i in builtins.range(n):
+        chunk = files[len(files) * i // n: len(files) * (i + 1) // n]
+        if chunk:
+            parts.append((functools.partial(read_many, chunk), []))
+    return Dataset(parts)
+
+
+def read_parquet(paths, *, override_num_blocks: Optional[int] = None,
+                 columns: Optional[List[str]] = None) -> Dataset:
+    def reader(f: str) -> Block:
+        import pyarrow.parquet as pq
+
+        return Block.from_arrow(pq.read_table(f, columns=columns))
+
+    return _read_source(
+        _expand_paths(paths, (".parquet",)), reader, override_num_blocks
+    )
+
+
+def read_csv(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    def reader(f: str) -> Block:
+        import pyarrow.csv as pacsv
+
+        return Block.from_arrow(pacsv.read_csv(f))
+
+    return _read_source(
+        _expand_paths(paths, (".csv",)), reader, override_num_blocks
+    )
+
+
+def read_json(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    def reader(f: str) -> Block:
+        import pyarrow.json as pajson
+
+        return Block.from_arrow(pajson.read_json(f))
+
+    return _read_source(
+        _expand_paths(paths, (".json", ".jsonl")), reader, override_num_blocks
+    )

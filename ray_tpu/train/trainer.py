@@ -95,7 +95,7 @@ class DataParallelTrainer:
                 group.setup(
                     restore.path if restore else None,
                     shards,
-                    collective_group=f"train:{name}",
+                    collective_group=f"train-{name}",
                 )
                 group.start_training(self.train_loop, self.train_loop_config)
                 last_metrics, history_part = self._drive(group, manager)
