@@ -1,0 +1,244 @@
+"""Core + object-plane microbenchmark.
+
+Role-equivalent to the reference's `ray microbenchmark`
+(reference: python/ray/_private/ray_perf.py:93, timing harness
+ray_microbenchmark_helpers.py:15) plus the release many_tasks /
+object_store scalability probes (release/benchmarks/).
+
+Prints one JSON line per metric:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+where vs_baseline divides by the reference's published number for the same
+shape of operation (BASELINE.md; m4.16xlarge-class release logs 2.9.3).
+Ends with a human-readable gap table on stderr and writes BENCH_CORE.json.
+
+Run:  python bench_core.py            (full suite, ~2-3 min)
+      python bench_core.py --quick    (shorter reps for smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# The control plane, not JAX, is under test; keep workers light and on CPU.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RT_PRESTART_WORKERS", "8")
+
+import numpy as np
+
+import ray_tpu
+
+# Reference numbers from BASELINE.md (release_logs/2.9.3/microbenchmark.json
+# and benchmarks/many_tasks.json).
+BASELINE = {
+    "single_client_get_small": 10182.0,       # gets/s
+    "single_client_put_small": 5545.0,        # puts/s
+    "single_client_put_gib": 20.88,           # GiB/s
+    "single_client_tasks_sync": 1007.0,       # round-trips/s
+    "single_client_tasks_async": 8444.0,      # submits+drain/s
+    "actor_calls_sync_1_1": 2033.0,           # calls/s
+    "actor_calls_async_1_1": 8886.0,          # calls/s
+    "actor_calls_async_n_n": 27667.0,         # calls/s
+    "actor_creation_rate": 580.1,             # actors/s (10k-actor run)
+    "pg_create_remove": 796.6,                # ops/s
+    "scheduling_throughput": 588.9,           # tasks/s (many_tasks)
+    # 1 GiB broadcast to 50 nodes took 20.24 s => each node sustained at
+    # least 1/20.24 GiB/s pulling its copy (object_store.json).
+    "cross_node_pull_gib": 1.0 / 20.24,
+}
+
+RESULTS = []
+
+
+def timeit(name, fn, multiplier=1, min_time=1.0, warmup=1):
+    """ops/s of fn, where one fn() call == `multiplier` operations."""
+    for _ in range(warmup):
+        fn()
+    reps = 0
+    start = time.perf_counter()
+    while True:
+        fn()
+        reps += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= min_time:
+            break
+    rate = reps * multiplier / elapsed
+    record(name, rate, "ops/s")
+    return rate
+
+
+def record(name, value, unit):
+    base = BASELINE.get(name)
+    entry = {
+        "metric": name,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / base, 3) if base else None,
+    }
+    RESULTS.append(entry)
+    print(json.dumps(entry), flush=True)
+
+
+def bench_single_node(quick: bool):
+    mt = 0.4 if quick else 1.2
+
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Srv:
+        def ping(self):
+            return b"ok"
+
+        async def aping(self):
+            return b"ok"
+
+    # -- object plane, small ops
+    ref = ray_tpu.put(0)
+    timeit("single_client_get_small", lambda: ray_tpu.get(ref), min_time=mt)
+    timeit("single_client_put_small", lambda: ray_tpu.put(0), min_time=mt)
+
+    # -- object plane, bandwidth (1 GiB total per rep in 256 MiB puts)
+    arr = np.zeros(256 * 1024 * 1024, dtype=np.uint8)
+
+    def put_gib():
+        refs = [ray_tpu.put(arr) for _ in range(4)]
+        del refs
+
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < (1.0 if quick else 3.0):
+        put_gib()
+        n += 1
+    record("single_client_put_gib", n / (time.perf_counter() - t0), "GiB/s")
+
+    big_ref = ray_tpu.put(arr)
+
+    def get_gib():
+        for _ in range(4):
+            ray_tpu.get(big_ref)
+
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < (1.0 if quick else 3.0):
+        get_gib()
+        n += 1
+    record("single_client_get_gib", n / (time.perf_counter() - t0), "GiB/s")
+    del big_ref, arr
+
+    # -- tasks
+    timeit("single_client_tasks_sync",
+           lambda: ray_tpu.get(nop.remote()), min_time=mt)
+    timeit("single_client_tasks_async",
+           lambda: ray_tpu.get([nop.remote() for _ in range(100)]),
+           multiplier=100, min_time=mt)
+
+    # -- actors
+    a = Srv.remote()
+    ray_tpu.get(a.ping.remote())
+    timeit("actor_calls_sync_1_1", lambda: ray_tpu.get(a.ping.remote()),
+           min_time=mt)
+    timeit("actor_calls_async_1_1",
+           lambda: ray_tpu.get([a.ping.remote() for _ in range(100)]),
+           multiplier=100, min_time=mt)
+
+    servers = [Srv.remote() for _ in range(4)]
+    ray_tpu.get([s.ping.remote() for s in servers])
+
+    def n_n():
+        refs = []
+        for s in servers:
+            refs.extend(s.ping.remote() for _ in range(50))
+        ray_tpu.get(refs)
+
+    timeit("actor_calls_async_n_n", n_n, multiplier=200, min_time=mt)
+
+    # -- actor creation rate (reference: many_actors.json, 580.1/s)
+    n_create = 20 if quick else 60
+
+    def create_actors():
+        handles = [Srv.remote() for _ in range(n_create)]
+        ray_tpu.get([h.ping.remote() for h in handles])
+        for h in handles:
+            ray_tpu.kill(h)
+
+    timeit("actor_creation_rate", create_actors, multiplier=n_create,
+           min_time=mt, warmup=0)
+
+    # -- placement groups
+    def pg_cycle():
+        pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+        pg.ready(timeout=5)
+        ray_tpu.remove_placement_group(pg)
+
+    timeit("pg_create_remove", pg_cycle, min_time=mt)
+
+    # -- scheduling throughput: a burst of tasks through the full scheduler
+    n_tasks = 200 if quick else 1000
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n_tasks)])
+    record("scheduling_throughput", n_tasks / (time.perf_counter() - t0),
+           "tasks/s")
+
+
+def bench_cross_node(quick: bool):
+    """Cross-node object pull bandwidth through the node-daemon object plane."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=2)
+    try:
+        cluster.add_node(num_cpus=2)
+
+        @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=1)
+        def make_big(mib):
+            import numpy as np
+            return np.zeros(mib * 1024 * 1024, dtype=np.uint8)
+
+        @ray_tpu.remote(num_cpus=1)
+        def remote_hold():
+            import time
+            time.sleep(0.01)
+
+        mib = 64 if quick else 256
+        # Produce the object on the remote node (SPREAD with the head's
+        # driver-side workers busy is not guaranteed, so produce two and pull
+        # whichever is non-local — the pull path is what's measured).
+        refs = [make_big.remote(mib) for _ in range(2)]
+        t0 = time.perf_counter()
+        vals = ray_tpu.get(refs)
+        dt = time.perf_counter() - t0
+        total_gib = len(vals) * mib / 1024.0
+        record("cross_node_pull_gib", total_gib / dt, "GiB/s")
+        del vals, refs
+    finally:
+        cluster.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--skip-multinode", action="store_true")
+    args = ap.parse_args()
+
+    ray_tpu.init(num_cpus=8)
+    bench_single_node(args.quick)
+    ray_tpu.shutdown()
+
+    if not args.skip_multinode:
+        bench_cross_node(args.quick)
+
+    with open(os.path.join(os.path.dirname(__file__), "BENCH_CORE.json"),
+              "w") as f:
+        json.dump(RESULTS, f, indent=1)
+
+    print("\n== gap vs reference (BASELINE.md) ==", file=sys.stderr)
+    for r in RESULTS:
+        if r["vs_baseline"] is not None:
+            print(f"  {r['metric']:<28} {r['value']:>12.1f} {r['unit']:<7} "
+                  f"{r['vs_baseline']:>8.2f}x of reference", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
