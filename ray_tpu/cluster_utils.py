@@ -152,9 +152,15 @@ class Cluster:
         import glob
 
         for session in self._sessions:
-            for path in glob.glob(f"/dev/shm/rtpu-{session}-*"):
+            for path in glob.glob(f"/dev/shm/rtpu-{session}-*") + glob.glob(
+                f"/dev/shm/rtpu-pool-{session}/*"
+            ):
                 try:
                     os.unlink(path)
                 except OSError:
                     pass
+            try:
+                os.rmdir(f"/dev/shm/rtpu-pool-{session}")
+            except OSError:
+                pass
         self._sessions.clear()

@@ -283,9 +283,17 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True):
 
 def _export(blob: bytes, prefix: str) -> str:
     """Export a pickled function/class to the cluster function table, dedup by
-    content hash (reference: src/ray/gcs/gcs_server/gcs_function_manager.h)."""
+    content hash (reference: src/ray/gcs/gcs_server/gcs_function_manager.h).
+    The export rides the background pipeline: the head processes it before
+    any submission that references it (same connection, FIFO)."""
     key = f"{prefix}:{hashlib.sha1(blob).hexdigest()}"
-    ctx.client.kv_put(key, blob, overwrite=False)
+    if key not in ctx.client.exported_keys:
+        # First export of a key is synchronous so a failure (e.g. a blob over
+        # the rpc size limit) raises here and is retried on the next call —
+        # caching the key before a background send succeeded would suppress
+        # re-export forever.  Amortized cost: one round trip per function.
+        ctx.client.kv_put(key, blob, overwrite=False)
+        ctx.client.exported_keys.add(key)
     return key
 
 
@@ -392,7 +400,10 @@ class RemoteFunction:
             "retry_exceptions": bool(o.get("retry_exceptions", False)),
             "runtime_env": o.get("runtime_env"),
         }
-        ctx.client.call("submit_task", spec)
+        # Submission is pipelined: the ref is returned immediately and the
+        # spec rides the ordered connection (reference: task submission is
+        # async; errors surface on ray.get of the returned ref).
+        ctx.client.call_bg("submit_task", spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(r) for r in return_ids]
@@ -460,7 +471,7 @@ class ActorHandle:
             "return_ids": [r.binary() for r in return_ids],
             "max_retries": self._max_task_retries,
         }
-        ctx.client.call("submit_actor_task", spec)
+        ctx.client.call_bg("submit_actor_task", spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(r) for r in return_ids]

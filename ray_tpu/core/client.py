@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .. import exceptions
@@ -17,7 +19,7 @@ from . import serialization
 from .config import get_config
 from .ids import NodeID, ObjectID
 from .object_store import StoreClient
-from .rpc import RpcClient
+from .rpc import ConnectionLost, RpcClient
 
 
 class Client:
@@ -48,6 +50,33 @@ class Client:
         )
         self.kind = kind
         self._stores: Dict[str, StoreClient] = {}
+        # In-process store for small objects this process owns or has read
+        # (packed blobs, LRU-bounded).  The analog of the reference's
+        # CoreWorkerMemoryStore (src/ray/core_worker/store_provider/
+        # memory_store/memory_store.h:43): puts and repeated gets of small
+        # objects never pay a control-plane round trip.
+        self._local: "OrderedDict[ObjectID, bytes]" = OrderedDict()
+        self._local_bytes = 0
+        self._local_cap = get_config().local_store_max_bytes
+        self._local_lock = threading.Lock()
+        # In-flight fire-and-forget RPCs (registrations, submissions): a
+        # bounded pipeline so submission throughput isn't gated on one
+        # round trip per call (reference: task submission is async; errors
+        # surface on the returned ref).
+        self._bg_futs: deque = deque()
+        self._bg_lock = threading.Lock()
+        self._bg_exc: Optional[BaseException] = None
+        # Buffered inline-object registrations (flushed as one RPC before
+        # any other outbound call — see _flush_put_batch).
+        self._put_batch: List[dict] = []
+        self._put_batch_lock = threading.Lock()
+        # Function-table keys this process has already exported (api._export).
+        self.exported_keys: set = set()
+        # Object ids of large (shm) objects this process put: their frees
+        # flush immediately instead of batching, so multi-MiB segments return
+        # to the store's warm pool promptly rather than forcing spills.
+        self.large_oids: set = set()
+        self._last_large_free = 0.0
         self._sub_handlers: Dict[str, List[Callable]] = {}
         self._sub_lock = threading.Lock()
         # Connections to other nodes' object-plane (pull) servers.
@@ -66,9 +95,110 @@ class Client:
         return st
 
     def _on_object_free(self, body):
+        dirty: List[bytes] = []
         for raw in body.get("object_ids", []):
+            oid = ObjectID(raw)
+            self._local_drop(oid)
+            clean = True
             for st in self._stores.values():
-                st.detach(ObjectID(raw))
+                if not st.detach(oid):
+                    clean = False
+            if not clean:
+                dirty.append(raw)
+        token = body.get("ack_token")
+        if token is not None:
+            # Runs on the rpc loop thread: fire-and-forget (a blocking call
+            # here would deadlock the loop).  The head pools the segments
+            # only after this ack; dirty ids (live zero-copy views in this
+            # process) are unlinked instead so the views stay valid.
+            try:
+                self.rpc.call_async(
+                    "object_free_ack", {"token": token, "dirty": dirty}
+                )
+            except Exception:
+                pass
+
+    # -- in-process store / background pipeline --------------------------------
+
+    def _local_put(self, oid: ObjectID, blob: bytes):
+        with self._local_lock:
+            prev = self._local.pop(oid, None)
+            if prev is not None:
+                self._local_bytes -= len(prev)
+            self._local[oid] = blob
+            self._local_bytes += len(blob)
+            while self._local_bytes > self._local_cap and self._local:
+                _, victim = self._local.popitem(last=False)
+                self._local_bytes -= len(victim)
+
+    def _local_get(self, oid: ObjectID) -> Optional[bytes]:
+        with self._local_lock:
+            blob = self._local.get(oid)
+            if blob is not None:
+                self._local.move_to_end(oid)
+            return blob
+
+    def _local_drop(self, oid: ObjectID):
+        with self._local_lock:
+            blob = self._local.pop(oid, None)
+            if blob is not None:
+                self._local_bytes -= len(blob)
+
+    def call_bg(self, method: str, body: Any):
+        """Fire an RPC without waiting for the reply.  Ordering vs later
+        calls on this client is preserved (single connection, FIFO).  Errors
+        surface on the next synchronous call; a bounded in-flight window
+        applies backpressure when the head falls behind."""
+        self._flush_put_batch()
+        self._call_bg_raw(method, body)
+
+    def _call_bg_raw(self, method: str, body: Any):
+        with self._bg_lock:
+            while self._bg_futs and self._bg_futs[0].done():
+                self._note_bg_exc(self._bg_futs.popleft())
+            if len(self._bg_futs) >= 1000:
+                self._note_bg_exc(self._bg_futs.popleft(), wait=True)
+            self._bg_futs.append(self.rpc.call_async(method, body))
+
+    def _flush_put_batch(self):
+        """Send buffered inline-object registrations as one RPC.  Flushed
+        before ANY other outbound call so no message that could reference a
+        buffered object ever overtakes its registration."""
+        with self._put_batch_lock:
+            batch, self._put_batch = self._put_batch, []
+        if batch:
+            self._call_bg_raw("put_object_batch", {"objects": batch})
+
+    def _note_bg_exc(self, fut, wait: bool = False):
+        try:
+            if wait:
+                fut.result(timeout=60)
+                exc = None
+            else:
+                exc = fut.exception()
+        except BaseException as e:  # noqa: BLE001
+            exc = e
+        if exc is not None and not isinstance(exc, ConnectionLost):
+            self._bg_exc = exc
+
+    def check_bg(self):
+        """Raise (once) a deferred error from the background pipeline."""
+        exc, self._bg_exc = self._bg_exc, None
+        if exc is not None:
+            raise exc
+
+    def drain_bg(self, timeout: float = 30.0):
+        """Block until all fired background RPCs have been acknowledged."""
+        self._flush_put_batch()
+        with self._bg_lock:
+            futs, self._bg_futs = list(self._bg_futs), deque()
+        for f in futs:
+            try:
+                f.result(timeout=timeout)
+            except BaseException as e:  # noqa: BLE001
+                if not isinstance(e, ConnectionLost):
+                    self._bg_exc = e
+        self.check_bg()
 
     # -- objects ---------------------------------------------------------------
 
@@ -84,12 +214,27 @@ class Client:
         if size <= cfg.inline_object_max_bytes:
             blob = bytearray(size)
             serialization.pack_into(meta, buffers, memoryview(blob))
-            self.rpc.call("put_object", {"object_id": oid.binary(),
-                                         "inline": bytes(blob)})
+            blob = bytes(blob)
+            self._local_put(oid, blob)
+            with self._put_batch_lock:
+                self._put_batch.append(
+                    {"object_id": oid.binary(), "inline": blob}
+                )
+                n = len(self._put_batch)
+            if n >= 64:
+                self._flush_put_batch()
         else:
-            buf = self.store().create(oid, size)
+            # If this process freed large objects moments ago, their warm
+            # segments are on their way to the pool (free -> detach-ack ->
+            # pool, a few ms): a short wait claims warm pages instead of
+            # paying cold first-touch faults.
+            wait = (
+                0.06 if time.monotonic() - self._last_large_free < 0.5 else 0.0
+            )
+            buf = self.store().create(oid, size, wait_pool_s=wait)
             serialization.pack_into(meta, buffers, buf)
-            self.rpc.call(
+            self.large_oids.add(oid.binary())
+            self.call_bg(
                 "put_object",
                 {"object_id": oid.binary(), "size": size,
                  "node_id": self.node_id.binary()},
@@ -123,6 +268,7 @@ class Client:
 
     def get_raw(self, object_ids: Sequence[ObjectID], timeout: float = -1.0):
         """Fetch wire descriptors for objects (blocking until sealed)."""
+        self._flush_put_batch()
         with self._maybe_blocked():
             reply = self.rpc.call(
                 "get_objects",
@@ -132,14 +278,32 @@ class Client:
         return reply["objects"]
 
     def get(self, refs: Sequence, timeout: float = -1.0) -> List[Any]:
+        self.check_bg()
         object_ids = [r.object_id for r in refs]
-        descs = self.get_raw(object_ids, timeout)
+        # In-process store first: objects this process put or already read
+        # resolve without a control-plane round trip.
+        local: Dict[int, bytes] = {}
+        missing: List[ObjectID] = []
+        for i, oid in enumerate(object_ids):
+            blob = self._local_get(oid)
+            if blob is not None:
+                local[i] = blob
+            else:
+                missing.append(oid)
+        descs = iter(self.get_raw(missing, timeout) if missing else ())
         out = []
-        for oid, desc in zip(object_ids, descs):
+        for i, oid in enumerate(object_ids):
+            if i in local:
+                out.append(serialization.unpack(local[i]))
+                continue
+            desc = next(descs)
             if desc.get("timeout"):
                 raise exceptions.GetTimeoutError(
                     f"ray_tpu.get timed out after {timeout}s on {oid}"
                 )
+            inline = desc.get("inline")
+            if inline is not None and desc.get("error") is None:
+                self._local_put(oid, inline)
             out.append(self._materialize(oid, desc))
         return out
 
@@ -236,6 +400,7 @@ class Client:
         return view
 
     def wait(self, refs: Sequence, num_returns: int, timeout: float):
+        self._flush_put_batch()
         with self._maybe_blocked():
             reply = self.rpc.call(
                 "wait_objects",
@@ -252,6 +417,15 @@ class Client:
         return ready, not_ready
 
     def free_objects(self, raw_ids: List[bytes]):
+        for raw in raw_ids:
+            self._local_drop(ObjectID(raw))
+            if raw in self.large_oids:
+                self._last_large_free = time.monotonic()
+            self.large_oids.discard(raw)
+        # Flush buffered registrations first: freeing an object whose
+        # registration is still batched would hit an unknown record head-side
+        # and the late registration would then resurrect it as a leak.
+        self._flush_put_batch()
         self.rpc.call("free_objects", {"object_ids": raw_ids})
 
     def add_reference(self, raw_id: bytes):
@@ -307,9 +481,15 @@ class Client:
     # -- passthrough -----------------------------------------------------------
 
     def call(self, method: str, body=None, timeout: float = 60.0):
+        self.check_bg()
+        self._flush_put_batch()
         return self.rpc.call(method, body, timeout=timeout)
 
     def close(self):
+        try:
+            self.drain_bg(timeout=5.0)
+        except BaseException:  # noqa: BLE001 — shutdown is best-effort
+            pass
         for st in self._stores.values():
             st.close()
         self.rpc.close()

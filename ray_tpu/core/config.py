@@ -33,8 +33,14 @@ class Config:
     # through shared memory (analog of Ray's in-process memory store for small
     # objects, reference: src/ray/core_worker/store_provider/memory_store).
     inline_object_max_bytes: int = 100 * 1024
+    # Per-process in-process store budget for small objects (the analog of
+    # the reference's CoreWorkerMemoryStore): owned puts and read inline
+    # values are cached here so repeated gets skip the control plane.
+    local_store_max_bytes: int = 128 * 1024 * 1024
     # Total shared-memory budget per node before eviction/spilling kicks in.
-    object_store_memory: int = 2 * 1024**3
+    # 0 = auto: 30% of system RAM (the reference's default share), capped at
+    # 32 GiB (resolved in __post_init__).
+    object_store_memory: int = 0
     # Directory used for spilling objects under memory pressure
     # (reference: python/ray/_private/external_storage.py FileSystemStorage).
     spill_dir: str = "/tmp/ray_tpu_spill"
@@ -76,9 +82,18 @@ class Config:
     task_events_buffer_size: int = 100_000
     enable_timeline: bool = True
 
+    def __post_init__(self):
+        if self.object_store_memory == 0:
+            try:
+                ram = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+            except (ValueError, OSError):
+                ram = 8 * 1024**3
+            self.object_store_memory = min(int(ram * 0.30), 32 * 1024**3)
+
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):
             setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+        self.__post_init__()
         return self
 
     def apply_overrides(self, overrides: Dict[str, Any] | None) -> "Config":
@@ -86,6 +101,7 @@ class Config:
             if not hasattr(self, k):
                 raise ValueError(f"Unknown system_config key: {k}")
             setattr(self, k, v)
+        self.__post_init__()  # re-resolve auto (0) values
         return self
 
 

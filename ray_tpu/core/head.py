@@ -19,8 +19,10 @@ from __future__ import annotations
 
 import asyncio
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Set
@@ -114,6 +116,20 @@ class TaskRecord:
     def strategy(self) -> SchedulingStrategy:
         return _strategy_from_wire(self.spec.get("strategy"))
 
+    def shape_key(self) -> tuple:
+        """Placement-equivalence key: tasks with equal keys place (or fail to
+        place) identically in a given cluster state — the analog of the
+        reference's SchedulingClass (src/ray/common/task/task_spec.h)."""
+        res = self.spec.get("resources")
+        strat = self.spec.get("strategy")
+        return (
+            tuple(sorted(res.items())) if res else None,
+            tuple(sorted(
+                (k, v if not isinstance(v, (bytes, bytearray)) else bytes(v))
+                for k, v in strat.items()
+            )) if strat else None,
+        )
+
 
 class ActorRecord:
     def __init__(self, actor_id: ActorID, spec: dict):
@@ -189,6 +205,9 @@ class Head:
         self.node_worker_counts: Dict[NodeID, int] = {}
         self.local_node_id: Optional[NodeID] = None
         self.worker_procs: List[subprocess.Popen] = []
+        self.worker_pids: List[int] = []  # zygote-forked (init reaps them)
+        self._zygote = None
+        self._zygote_mutex = threading.Lock()
         self.node_daemons: Dict[NodeID, Connection] = {}
         # Object-plane server address per node (chunked pull endpoint).
         self.node_object_addrs: Dict[NodeID, str] = {}
@@ -199,16 +218,22 @@ class Head:
         # Placement groups waiting for resources to free up (reference:
         # gcs_placement_group_manager queues pending PGs).
         self.pending_pgs: "Dict[PlacementGroupID, dict]" = {}
+        self._pending_frees: Dict[int, dict] = {}
+        self._free_token = 0
         self.pg_waiters: Dict[PlacementGroupID, List[asyncio.Event]] = {}
         self._periodic_task: Optional[asyncio.Task] = None
+        self._tick_task: Optional[asyncio.Task] = None
         self._shutdown = False
+        self._kick_scheduled = False
         self.job_start_time = time.time()
 
         for name in [
             "register", "kv_put", "kv_get", "kv_del", "kv_keys",
             "submit_task", "create_actor", "submit_actor_task",
-            "task_done", "stream_item", "put_object", "get_objects",
-            "wait_objects", "free_objects", "add_object_ref",
+            "task_done", "stream_item", "put_object", "put_object_batch",
+            "get_objects",
+            "wait_objects", "free_objects", "object_free_ack",
+            "add_object_ref",
             "create_placement_group", "remove_placement_group",
             "kill_actor", "cancel_task", "get_actor_by_name", "list_named_actors",
             "worker_ready",
@@ -264,10 +289,19 @@ class Head:
         self._kick()
 
     def _kick(self):
-        """Schedule a dispatch pass on the loop."""
-        asyncio.get_running_loop().call_soon(
-            lambda: asyncio.ensure_future(self._dispatch_loop())
-        )
+        """Schedule a dispatch pass on the loop.  Coalesced: a burst of
+        submissions (the client pipelines them) triggers one pass, not one
+        pass per task — each pass scans the whole queue, so per-call passes
+        turn a k-task burst into O(k²) scheduler work."""
+        if self._kick_scheduled:
+            return
+        self._kick_scheduled = True
+
+        def run():
+            self._kick_scheduled = False
+            asyncio.ensure_future(self._dispatch_loop())
+
+        asyncio.get_running_loop().call_soon(run)
 
     # -------------------------------------------------------------- lifecycle
 
@@ -281,6 +315,18 @@ class Head:
         that start the RpcServer directly must invoke this themselves)."""
         if self._periodic_task is None:
             self._periodic_task = asyncio.ensure_future(self._periodic_loop())
+            self._tick_task = asyncio.ensure_future(self._store_tick_loop())
+
+    async def _store_tick_loop(self):
+        """Move cooled freed segments into the warm pool promptly (the main
+        periodic loop may run at a coarser health-check cadence)."""
+        while not self._shutdown:
+            await asyncio.sleep(0.25)
+            try:
+                self.store.tick()
+                self._expire_pending_frees()
+            except Exception:
+                pass
 
     async def _periodic_loop(self):
         """Housekeeping: worker health probes, idle-worker reaping, spawn
@@ -292,6 +338,14 @@ class Head:
             try:
                 await asyncio.sleep(period)
                 now = time.monotonic()
+                self.store.tick()  # cooled freed segments -> warm pool
+                # Prune exited zygote-forked workers (orphans reaped by
+                # init) so shutdown never signals a recycled pid.
+                for pid in list(self.worker_pids):
+                    try:
+                        os.kill(pid, 0)
+                    except (ProcessLookupError, PermissionError):
+                        self.worker_pids.remove(pid)
                 # Health probes: push to every worker; acks come back via
                 # h_health_ack.  A wedged process keeps the TCP connection
                 # open but its rpc loop stops acking.
@@ -381,6 +435,8 @@ class Head:
         self._shutdown = True
         if self._periodic_task is not None:
             self._periodic_task.cancel()
+        if self._tick_task is not None:
+            self._tick_task.cancel()
         for w in self.workers.values():
             if w.conn.alive:
                 try:
@@ -391,6 +447,13 @@ class Head:
         for p in self.worker_procs:
             if p.poll() is None:
                 p.terminate()
+        for pid in self.worker_pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if self._zygote is not None:
+            self._zygote.close()
         await self.server.stop()
         self.store.shutdown()
 
@@ -404,11 +467,18 @@ class Head:
         self.node_worker_counts[node_id] = 0
         self._spawn_pending[node_id] = 0
         self.node_object_addrs[node_id] = f"{self.host}:{self.port}"
+        # Boot the local zygote eagerly: its one-time import cost overlaps
+        # driver startup instead of delaying the first worker spawn.
+        if self._zygote is None:
+            try:
+                from .zygote import Zygote
+
+                self._zygote = Zygote(self._worker_base_env(node_id))
+            except Exception:
+                self._zygote = None
         return node_id
 
-    def _spawn_worker(self, node_id: NodeID):
-        """Spawn a worker process for a node (local nodes only; remote nodes
-        get a spawn_worker push to their daemon)."""
+    def _worker_base_env(self, node_id: NodeID) -> Dict[str, str]:
         env = dict(os.environ)
         # CPU workers must not claim the TPU: strip accelerator-session env so
         # plugin sitecustomize hooks (axon tunnel, libtpu) stay dormant.  The
@@ -436,6 +506,12 @@ class Head:
             # + runtime_env (see worker_main._maybe_enable_tpu).
             JAX_PLATFORMS=env_jax_platform(),
         )
+        return env
+
+    def _spawn_worker(self, node_id: NodeID):
+        """Spawn a worker process for a node (local nodes only; remote nodes
+        get a spawn_worker push to their daemon)."""
+        env = self._worker_base_env(node_id)
         daemon = self.node_daemons.get(node_id)
         self._spawn_pending[node_id] = self._spawn_pending.get(node_id, 0) + 1
         self._spawn_times.setdefault(node_id, deque()).append(time.monotonic())
@@ -444,17 +520,39 @@ class Head:
             return
         log_dir = os.path.join("/tmp/ray_tpu_logs", self.session)
         os.makedirs(log_dir, exist_ok=True)
-        logf = open(
-            os.path.join(log_dir, f"worker-{time.time_ns()}.log"), "wb"
-        )
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env,
-            stdout=logf,
-            stderr=subprocess.STDOUT,
-        )
-        logf.close()
-        self.worker_procs.append(proc)
+        log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
+
+        # Spawn off-loop: the zygote handshake (or a fallback interpreter
+        # boot) must never block the control plane's event loop.
+        def do_spawn():
+            with self._zygote_mutex:
+                try:
+                    if self._zygote is None or not self._zygote.alive():
+                        from .zygote import Zygote
+
+                        self._zygote = Zygote(env)
+                    # Fork from the zygote (pre-imported worker runtime, ~ms)
+                    # instead of booting a fresh interpreter (~0.5s).
+                    pid = self._zygote.spawn(
+                        {k: v for k, v in env.items()
+                         if k.startswith(("RT_", "JAX_", "PYTHON"))},
+                        log=log_path,
+                    )
+                    self.worker_pids.append(pid)
+                    return
+                except Exception:
+                    pass  # fall back to a direct interpreter boot
+            logf = open(log_path, "wb")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+            )
+            logf.close()
+            self.worker_procs.append(proc)
+
+        asyncio.get_running_loop().run_in_executor(None, do_spawn)
 
     # ------------------------------------------------------------- handlers
 
@@ -505,6 +603,11 @@ class Head:
     async def _on_disconnect(self, conn: Connection):
         worker_id = self.conn_to_worker.pop(conn.conn_id, None)
         if worker_id is not None:
+            w = self.workers.get(worker_id)
+            if w is not None and w.pid in self.worker_pids:
+                # Exited zygote-forked worker: drop the pid now so a later
+                # shutdown can't signal a recycled pid.
+                self.worker_pids.remove(w.pid)
             await self._handle_worker_death(worker_id)
         node_id = conn.meta.get("node_id")
         if node_id is not None and conn.meta.get("kind") == "node":
@@ -600,6 +703,19 @@ class Head:
         self._notify_object_ready(oid)
         return {}
 
+    async def h_put_object_batch(self, conn, body):
+        """Registration batch for inline objects (client-side put buffering:
+        one RPC per ~64 small puts instead of one each)."""
+        for entry in body["objects"]:
+            oid = ObjectID(entry["object_id"])
+            rec = self._obj(oid)
+            rec.inline = entry["inline"]
+            rec.size = len(rec.inline)
+            rec.sealed = True
+            rec.ref_count = max(rec.ref_count, 1)
+            self._notify_object_ready(oid)
+        return {}
+
     def _adopt_local(self, oid: ObjectID, node_id: Optional[NodeID]):
         """Account a shm object with its node's store daemon (enables
         eviction, spilling, and shutdown cleanup): local objects go into the
@@ -630,8 +746,7 @@ class Head:
         return {}
 
     async def h_free_objects(self, conn, body):
-        freed = []
-        freed_locations: Set[NodeID] = set()
+        items = []
         for raw in body["object_ids"]:
             oid = ObjectID(raw)
             rec = self.objects.get(oid)
@@ -639,36 +754,94 @@ class Head:
                 continue
             rec.ref_count -= 1
             if rec.ref_count <= 0:
-                freed_locations.update(rec.locations)
                 self.objects.pop(oid, None)
-                self.store.free(oid)
-                freed.append(raw)
-        if freed:
-            await self._broadcast_free(freed, freed_locations)
-        return {"num_freed": len(freed)}
+                items.append((raw, set(rec.locations)))
+        if items:
+            await self._deferred_free(items)
+        return {"num_freed": len(items)}
 
-    async def _broadcast_free(self, freed: List[bytes],
-                              locations: Set[NodeID]):
-        """Tell the processes that could hold a copy to drop it: the store
-        daemons of the objects' location nodes unlink the segments, and
-        drivers/workers on those nodes detach (munmap) — clients install an
-        "object_free" push handler at connect (client.py).  Filtering by
-        location keeps the free path O(holders), not O(cluster)."""
-        body = {"object_ids": freed}
-        for node_id in locations:
+    async def _deferred_free(self, items: List[tuple]):
+        """Two-phase free: tell the processes that could hold a copy to drop
+        it; release (and pool) the segments only after they ack a clean
+        detach.  A reader whose user code still holds zero-copy views acks
+        *dirty* and the inode is unlinked instead of pooled, so the views
+        stay valid on the orphaned inode (reference: plasma keeps a buffer
+        alive while any client holds a reference; here the detach-ack is the
+        release edge).  Un-acked frees expire conservatively (no pooling)."""
+        raws = [raw for raw, _ in items]
+        locations: Set[NodeID] = set()
+        for _, locs in items:
+            locations.update(locs)
+        conns = [
+            c for c in self.server.connections.values()
+            if c.meta.get("kind") in ("driver", "worker")
+            and c.meta.get("reader_node") in locations
+        ]
+        if not conns:
+            self._finalize_free(items, dirty=set())
+            return
+        self._free_token += 1
+        token = self._free_token
+        pf = {
+            "items": items,
+            "waiting": {c.conn_id for c in conns},
+            "dirty": set(),
+            "deadline": time.monotonic() + 2.0,
+        }
+        self._pending_frees[token] = pf
+        body = {"object_ids": raws, "ack_token": token}
+        for c in conns:
+            try:
+                await c.push("object_free", body)
+            except Exception:
+                pf["waiting"].discard(c.conn_id)
+        if not pf["waiting"]:
+            self._pending_frees.pop(token, None)
+            self._finalize_free(items, dirty=set())
+
+    async def h_object_free_ack(self, conn, body):
+        pf = self._pending_frees.get(body["token"])
+        if pf is None:
+            return {}
+        pf["waiting"].discard(conn.conn_id)
+        pf["dirty"].update(body.get("dirty", ()))
+        if not pf["waiting"]:
+            self._pending_frees.pop(body["token"], None)
+            self._finalize_free(pf["items"], pf["dirty"])
+        return {}
+
+    def _expire_pending_frees(self):
+        now = time.monotonic()
+        for token in list(self._pending_frees):
+            pf = self._pending_frees[token]
+            if now >= pf["deadline"]:
+                self._pending_frees.pop(token, None)
+                # Unknown reader state: never pool (views may be live).
+                self._finalize_free(
+                    pf["items"], dirty={raw for raw, _ in pf["items"]}
+                )
+
+    def _finalize_free(self, items: List[tuple], dirty: set):
+        no_pool_by_node: Dict[NodeID, List[bytes]] = {}
+        by_node: Dict[NodeID, List[bytes]] = {}
+        for raw, locs in items:
+            oid = ObjectID(raw)
+            pool = raw not in dirty
+            if not locs or self.local_node_id in locs:
+                self.store.free(oid, pool=pool)
+            for node_id in locs:
+                if node_id == self.local_node_id:
+                    continue
+                by_node.setdefault(node_id, []).append(raw)
+                if not pool:
+                    no_pool_by_node.setdefault(node_id, []).append(raw)
+        for node_id, raws in by_node.items():
             daemon = self.node_daemons.get(node_id)
             if daemon is not None:
-                try:
-                    await daemon.push("free_objects", body)
-                except Exception:
-                    pass
-        for c in list(self.server.connections.values()):
-            if (c.meta.get("kind") in ("driver", "worker")
-                    and c.meta.get("reader_node") in locations):
-                try:
-                    await c.push("object_free", body)
-                except Exception:
-                    pass
+                asyncio.ensure_future(daemon.push("free_objects", {
+                    "object_ids": raws,
+                    "no_pool": no_pool_by_node.get(node_id, []),
+                }))
 
     def _object_wire(self, rec: ObjectRecord,
                      prefer: Optional[NodeID] = None) -> dict:
@@ -774,7 +947,9 @@ class Head:
         rec.ref_count -= 1
         if rec.ref_count <= 0:
             self.objects.pop(oid, None)
-            self.store.free(oid)
+            asyncio.ensure_future(
+                self._deferred_free([(oid.binary(), set(rec.locations))])
+            )
 
     def _unpin_task_args(self, task: TaskRecord):
         for raw in task.spec.get("arg_ids", []):
@@ -851,15 +1026,27 @@ class Head:
         while made_progress and self.queued_tasks:
             made_progress = False
             requeue: List[TaskRecord] = []
+            # Resource shapes that already failed to place this pass: later
+            # tasks with the same shape fail identically, so skip them — a
+            # 10k-task homogeneous burst costs one placement failure per
+            # pass, not 10k (reference: cluster_task_manager.h groups tasks
+            # by SchedulingClass for exactly this reason).
+            failed_shapes: set = set()
             while self.queued_tasks:
                 task = self.queued_tasks.popleft()
                 if task.state != PENDING:
                     continue
+                shape = task.shape_key()
+                if shape in failed_shapes:
+                    requeue.append(task)
+                    continue
                 node_id = self.scheduler.pick_node(task.resources, task.strategy)
                 if node_id is None:
+                    failed_shapes.add(shape)
                     requeue.append(task)
                     continue
                 if not self.scheduler.acquire(node_id, task.resources, task.strategy):
+                    failed_shapes.add(shape)
                     requeue.append(task)
                     continue
                 worker = self._find_idle_worker(node_id)
@@ -951,8 +1138,25 @@ class Head:
         hard_cap = max(cap, 1) * self.config.worker_pool_hard_cap_multiple
         if count + blocked + pending >= hard_cap:
             return
-        if count + pending < cap or (force and pending == 0):
+        if count + pending < cap:
             self._spawn_worker(node_id)
+            return
+        if force:
+            # Actor-creation tasks get dedicated processes: spawn one per
+            # parked creation so a burst of actors starts in parallel instead
+            # of one process per spawn-roundtrip (reference: worker_pool.h
+            # maximum_startup_concurrency governs parallel worker startup).
+            # `current_parked`: the caller's task is already in node_parked
+            # (_drain_parked) or about to be parked (_dispatch_loop) — count
+            # it exactly once either way.
+            parked_creations = sum(
+                1 for t in self.node_parked.get(node_id, ())
+                if t.spec.get("is_actor_creation")
+            )
+            needed = max(parked_creations, 1)
+            for _ in range(min(needed - pending,
+                               hard_cap - (count + blocked + pending))):
+                self._spawn_worker(node_id)
 
     async def _dispatch(self, task: TaskRecord, worker: WorkerState):
         task.state = RUNNING
@@ -1571,7 +1775,9 @@ class Head:
                 {"node_id": nid.hex(), **info}
                 for nid, info in (
                     (n.node_id, {"resources": n.total, "available": n.available,
-                                 "alive": n.alive, "labels": n.labels})
+                                 "alive": n.alive, "labels": n.labels,
+                                 "pending_spawns":
+                                     self._spawn_pending.get(n.node_id, 0)})
                     for n in self.scheduler.nodes.values()
                 )
             ]}

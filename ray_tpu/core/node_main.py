@@ -76,6 +76,11 @@ class NodeDaemon:
         self.server.register("ping", lambda conn, body: {"ok": True})
         self.server_thread = ServerThread(self.server)
         self.worker_procs: List[subprocess.Popen] = []
+        self.worker_pids: set = set()  # zygote-forked (orphaned to init)
+        self.zygote = None
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._spawn_exec = ThreadPoolExecutor(1, thread_name_prefix="spawner")
         self.node_id: Optional[NodeID] = None
         self.head: Optional[RpcClient] = None
         self._shutdown = threading.Event()
@@ -109,6 +114,14 @@ class NodeDaemon:
             body["node_id"] = bytes.fromhex(os.environ["RT_NODE_ID"])
         reply = self.head.call("register", body)
         self.node_id = NodeID(reply["node_id"])
+        # Boot the zygote eagerly so the first spawn request doesn't pay the
+        # forkserver's one-time import cost.
+        try:
+            from .zygote import Zygote
+
+            self.zygote = Zygote(self._worker_env())
+        except Exception:
+            self.zygote = None
 
     @staticmethod
     def _split(addr: str):
@@ -117,7 +130,7 @@ class NodeDaemon:
 
     # -- push handlers (run on the head-client rpc loop thread) ---------------
 
-    def _on_spawn_worker(self, body):
+    def _worker_env(self):
         env = dict(os.environ)
         for k in list(env):
             if k.startswith(("PALLAS_AXON", "TPU_", "AXON_")):
@@ -136,11 +149,35 @@ class NodeDaemon:
             RT_SESSION=self.session,
             JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
         )
+        return env
+
+    def _on_spawn_worker(self, body):
+        # Off-thread: this runs as a push handler on the head-client rpc
+        # loop; the zygote handshake must not stall pushes.
+        self._spawn_exec.submit(self._spawn_worker_blocking)
+
+    def _spawn_worker_blocking(self):
+        env = self._worker_env()
         log_dir = os.path.join("/tmp/ray_tpu_logs", self.session)
         os.makedirs(log_dir, exist_ok=True)
-        logf = open(
-            os.path.join(log_dir, f"worker-{time.time_ns()}.log"), "wb"
-        )
+        log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
+        # Fork from the pre-imported zygote (~ms) instead of booting a fresh
+        # interpreter (~0.5s); fall back to Popen if the zygote died.
+        try:
+            if self.zygote is None or not self.zygote.alive():
+                from .zygote import Zygote
+
+                self.zygote = Zygote(env)
+            pid = self.zygote.spawn(
+                {k: v for k, v in env.items()
+                 if k.startswith(("RT_", "JAX_", "PYTHON"))},
+                log=log_path,
+            )
+            self.worker_pids.add(pid)
+            return
+        except Exception:
+            pass
+        logf = open(log_path, "wb")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
@@ -153,19 +190,21 @@ class NodeDaemon:
     def _on_kill_worker(self, body):
         """SIGKILL a wedged local worker on the head's behalf — a stopped
         process can't run its connection-lost handler, so the daemon (which
-        holds the Popen handle) must deliver the signal (reference: raylet
-        DestroyWorker kills local worker processes)."""
+        spawned it) must deliver the signal (reference: raylet DestroyWorker
+        kills local worker processes)."""
         pid = body.get("pid")
-        if pid and any(p.pid == pid for p in self.worker_procs):
+        if pid and (pid in self.worker_pids
+                    or any(p.pid == pid for p in self.worker_procs)):
             try:
                 os.kill(pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
 
     def _on_free_objects(self, body):
+        no_pool = set(body.get("no_pool", ()))
         for raw in body.get("object_ids", []):
             try:
-                self.store.free(ObjectID(raw))
+                self.store.free(ObjectID(raw), pool=raw not in no_pool)
             except Exception:
                 pass
 
@@ -180,13 +219,34 @@ class NodeDaemon:
     # ------------------------------------------------------------------ loop
 
     def run(self):
+        ticks = 0
         while not self._shutdown.wait(timeout=0.2):
+            self.store.tick()  # cooled freed segments -> warm pool
             # Reap exited worker processes so they don't zombie.
             for p in self.worker_procs:
                 p.poll()
+            ticks += 1
+            if ticks % 10 == 0:
+                # Prune exited zygote-forked workers (orphans reaped by
+                # init): a stale pid could be recycled by an unrelated
+                # process and must never be signalled at shutdown.
+                for pid in list(self.worker_pids):
+                    try:
+                        os.kill(pid, 0)
+                    except ProcessLookupError:
+                        self.worker_pids.discard(pid)
+                    except PermissionError:
+                        self.worker_pids.discard(pid)  # recycled: not ours
         for p in self.worker_procs:
             if p.poll() is None:
                 p.terminate()
+        for pid in self.worker_pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if self.zygote is not None:
+            self.zygote.close()
         self.store.shutdown()
         os._exit(0)
 

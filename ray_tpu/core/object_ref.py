@@ -19,12 +19,26 @@ _free_lock = threading.Lock()
 _free_queue: list = []
 
 
-def _flush_free_queue():
+def _flush_free_queue(background: bool = False):
     with _free_lock:
         batch, _free_queue[:] = _free_queue[:], []
     if batch and ctx.client is not None:
         try:
-            ctx.client.free_objects(batch)
+            if background:
+                # __del__-triggered flushes must not block on a round trip;
+                # the pipelined call keeps frees prompt so large freed
+                # segments return to the store pool instead of forcing
+                # eviction/spill of live objects.
+                import time as _time
+
+                for raw in batch:
+                    ctx.client._local_drop(ObjectID(raw))
+                    if raw in ctx.client.large_oids:
+                        ctx.client._last_large_free = _time.monotonic()
+                    ctx.client.large_oids.discard(raw)
+                ctx.client.call_bg("free_objects", {"object_ids": batch})
+            else:
+                ctx.client.free_objects(batch)
         except Exception:
             pass
 
@@ -60,10 +74,11 @@ class ObjectRef:
 
     def __del__(self):
         if self._owned and ctx.client is not None:
+            raw = self._id.binary()
             with _free_lock:
-                _free_queue.append(self._id.binary())
-            if len(_free_queue) >= 100:
-                _flush_free_queue()
+                _free_queue.append(raw)
+            if len(_free_queue) >= 16 or raw in ctx.client.large_oids:
+                _flush_free_queue(background=True)
 
     def __reduce__(self):
         # Crossing a process boundary: the receiver holds a borrowed reference.

@@ -148,7 +148,10 @@ class Worker:
                 {"object_id": raw} for raw in spec.get("return_ids", [])
             ]
         try:
-            self.client.call("task_done", body)
+            # Pipelined: the worker moves to its next queued task without
+            # waiting a round trip (reference: PushTask replies carry results
+            # asynchronously).  Connection loss exits via on_connection_lost.
+            self.client.call_bg("task_done", body)
         except Exception:
             os._exit(1)
 
@@ -203,7 +206,7 @@ class Worker:
                 for item in result:
                     oid = ObjectID.for_task_return(TaskID(task_id), count + 1000)
                     info = self._store_value(oid, item)
-                    self.client.call(
+                    self.client.call_bg(
                         "stream_item",
                         {"task_id": task_id, "index": count, **info},
                     )
