@@ -220,21 +220,28 @@ def bench_cross_node(quick: bool):
             import numpy as np
             return np.zeros(mib * 1024 * 1024, dtype=np.uint8)
 
-        @ray_tpu.remote(num_cpus=1)
-        def remote_hold():
-            import time
-            time.sleep(0.01)
-
         mib = 64 if quick else 256
-        # Produce the object on the remote node (SPREAD with the head's
-        # driver-side workers busy is not guaranteed, so produce two and pull
-        # whichever is non-local — the pull path is what's measured).
+        # Produce on both nodes (SPREAD), wait for seal, then time ONLY the
+        # transfer of the copies that live on the other node — production
+        # cost (cold remote-store writes) must not pollute the number.
+        from ray_tpu.core.context import ctx
+
         refs = [make_big.remote(mib) for _ in range(2)]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
+        descs = ctx.client.get_raw([r.object_id for r in refs])
+        n_remote = sum(
+            1 for d in descs
+            if d.get("node_id") and d["node_id"] != ctx.client.node_id.binary()
+        )
         t0 = time.perf_counter()
         vals = ray_tpu.get(refs)
         dt = time.perf_counter() - t0
-        total_gib = len(vals) * mib / 1024.0
-        record("cross_node_pull_gib", total_gib / dt, "GiB/s")
+        if n_remote == 0:
+            print("cross_node_pull_gib: no remote copy produced; skipping",
+                  file=sys.stderr)
+        else:
+            record("cross_node_pull_gib", n_remote * mib / 1024.0 / dt,
+                   "GiB/s")
         del vals, refs
     finally:
         cluster.shutdown()

@@ -81,6 +81,7 @@ class Client:
         self._sub_lock = threading.Lock()
         # Connections to other nodes' object-plane (pull) servers.
         self._pull_conns: Dict[str, RpcClient] = {}
+        self._bulk_conns: Dict[str, tuple] = {}
         self._pull_lock = threading.Lock()
         self.rpc.on_push("pubsub", self._on_pubsub)
         self.rpc.on_push("object_free", self._on_object_free)
@@ -359,30 +360,58 @@ class Client:
             return existing
         size = desc["size"]
         buf, commit, abort = local.create_staged(oid, size)
+        bulk_addr = desc.get("bulk_addr")
+        if bulk_addr:
+            try:
+                self._bulk_pull(bulk_addr, oid, buf, size)
+                return self._commit_pull(oid, size, commit)
+            except exceptions.ObjectLostError:
+                abort()
+                raise
+            except Exception:
+                pass  # bulk channel unavailable: fall back to chunked RPC
         try:
+            # Pipelined chunk window: several chunk requests in flight on the
+            # one connection so the transfer overlaps server read, wire time
+            # and local memcpy (reference: object_manager.h:63 splits objects
+            # into chunks and streams them concurrently).
             rpc = self._pull_conn(addr)
-            off = 0
-            while off < size:
-                reply = rpc.call(
-                    "pull_object",
-                    {"object_id": oid.binary(), "offset": off,
-                     "max_bytes": PULL_CHUNK_BYTES},
-                    timeout=120.0,
-                )
+            window = 8
+            futs: Dict[int, Any] = {}
+            next_off = 0
+
+            def fire():
+                nonlocal next_off
+                while next_off < size and len(futs) < window:
+                    futs[next_off] = rpc.call_async(
+                        "pull_object",
+                        {"object_id": oid.binary(), "offset": next_off,
+                         "max_bytes": PULL_CHUNK_BYTES},
+                    )
+                    next_off += PULL_CHUNK_BYTES
+
+            fire()
+            while futs:
+                off = min(futs)
+                reply = futs.pop(off).result(timeout=120.0)
                 if not reply.get("found"):
                     raise exceptions.ObjectLostError(
                         f"object {oid} vanished from {addr} mid-pull"
                     )
                 data = reply["data"]
-                if not data:
+                want = min(PULL_CHUNK_BYTES, size - off)
+                if len(data) != want:
                     raise exceptions.ObjectLostError(
-                        f"object {oid}: empty chunk at offset {off} from {addr}"
+                        f"object {oid}: short chunk at offset {off} from {addr}"
                     )
                 buf[off:off + len(data)] = data
-                off += len(data)
+                fire()
         except Exception:
             abort()
             raise
+        return self._commit_pull(oid, size, commit)
+
+    def _commit_pull(self, oid: ObjectID, size: int, commit) -> memoryview:
         view = commit()
         # Register the new copy: same-node readers now attach via shm, and
         # the node's store daemon takes accounting ownership.  `from_pull`
@@ -398,6 +427,75 @@ class Client:
         except Exception:
             pass
         return view
+
+    def _bulk_conn(self, addr: str):
+        import socket
+
+        with self._pull_lock:
+            entry = self._bulk_conns.get(addr)
+        if entry is not None:
+            return entry
+        # Connect outside the lock: a 30s timeout on an unreachable node
+        # must not stall other threads' pull-connection lookups.
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        entry = (sock, threading.Lock())
+        with self._pull_lock:
+            racer = self._bulk_conns.get(addr)
+            if racer is not None:
+                sock.close()
+                return racer
+            self._bulk_conns[addr] = entry
+        return entry
+
+    def _bulk_pull(self, addr: str, oid: ObjectID, buf: memoryview, size: int):
+        """Raw-TCP transfer into the staged segment: request, then
+        recv_into() the mmap directly — no framing or intermediate copies
+        (server side is sendfile; see node_main.BulkServer)."""
+        import struct
+
+        from .node_main import BULK_NOT_FOUND
+
+        sock, lock = self._bulk_conn(addr)
+        try:
+            with lock:
+                sock.sendall(oid.binary() + struct.pack("<QQ", 0, size))
+                hdr = b""
+                while len(hdr) < 8:
+                    part = sock.recv(8 - len(hdr))
+                    if not part:
+                        raise ConnectionError("bulk channel closed")
+                    hdr += part
+                (n,) = struct.unpack("<Q", hdr)
+                if n == BULK_NOT_FOUND:
+                    raise exceptions.ObjectLostError(
+                        f"object {oid} vanished from {addr} mid-pull"
+                    )
+                if n != size:
+                    raise exceptions.ObjectLostError(
+                        f"object {oid}: bulk size mismatch ({n} != {size})"
+                    )
+                got = 0
+                while got < n:
+                    r = sock.recv_into(buf[got:], n - got)
+                    if r == 0:
+                        raise ConnectionError("bulk channel closed mid-body")
+                    got += r
+        except BaseException:
+            # Any failure leaves undrained body bytes on the stream — the
+            # connection is desynced and must not be reused (a poisoned
+            # socket would parse stale body bytes as the next length header,
+            # and the server would sit in sendfile holding a pin).
+            with self._pull_lock:
+                if self._bulk_conns.get(addr) is not None \
+                        and self._bulk_conns[addr][0] is sock:
+                    self._bulk_conns.pop(addr, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
 
     def wait(self, refs: Sequence, num_returns: int, timeout: float):
         self._flush_put_batch()

@@ -211,6 +211,7 @@ class Head:
         self.node_daemons: Dict[NodeID, Connection] = {}
         # Object-plane server address per node (chunked pull endpoint).
         self.node_object_addrs: Dict[NodeID, str] = {}
+        self.node_bulk_addrs: Dict[NodeID, str] = {}
         self.node_last_ack: Dict[NodeID, float] = {}
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
         self._spawn_pending: Dict[NodeID, int] = {}
@@ -454,6 +455,8 @@ class Head:
                 pass
         if self._zygote is not None:
             self._zygote.close()
+        if getattr(self, "_bulk_server", None) is not None:
+            self._bulk_server.close()
         await self.server.stop()
         self.store.shutdown()
 
@@ -467,6 +470,14 @@ class Head:
         self.node_worker_counts[node_id] = 0
         self._spawn_pending[node_id] = 0
         self.node_object_addrs[node_id] = f"{self.host}:{self.port}"
+        try:
+            from .node_main import BulkServer
+
+            self._bulk_server = BulkServer(self.store, self.session, self.host)
+            self._bulk_server.start()
+            self.node_bulk_addrs[node_id] = f"{self.host}:{self._bulk_server.port}"
+        except Exception:
+            self._bulk_server = None
         # Boot the local zygote eagerly: its one-time import cost overlaps
         # driver startup instead of delaying the first worker spawn.
         if self._zygote is None:
@@ -588,6 +599,8 @@ class Head:
             self.node_daemons[node_id] = conn
             if body.get("object_addr"):
                 self.node_object_addrs[node_id] = body["object_addr"]
+            if body.get("bulk_addr"):
+                self.node_bulk_addrs[node_id] = body["bulk_addr"]
             self.node_last_ack[node_id] = time.monotonic()
             conn.meta["kind"] = "node"
             conn.meta["node_id"] = node_id
@@ -613,6 +626,7 @@ class Head:
         if node_id is not None and conn.meta.get("kind") == "node":
             self.node_daemons.pop(node_id, None)
             self.node_object_addrs.pop(node_id, None)
+            self.node_bulk_addrs.pop(node_id, None)
             self.node_last_ack.pop(node_id, None)
             damaged = self.scheduler.remove_node(node_id)
             if damaged:
@@ -860,6 +874,7 @@ class Head:
             "session": self.node_sessions.get(loc, self.session),
             "node_id": loc.binary() if loc else None,
             "addr": self.node_object_addrs.get(loc),
+            "bulk_addr": self.node_bulk_addrs.get(loc),
         }
 
     async def h_get_objects(self, conn, body):

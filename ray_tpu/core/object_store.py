@@ -409,7 +409,9 @@ class StoreClient:
         objects are invisible until sealed)."""
         final = _seg_path(self._session, object_id)
         tmp = f"{final}.pull-{os.getpid()}-{os.urandom(4).hex()}"
-        seg = _Segment(tmp, size, create=True)
+        seg = _claim_pooled(self._session, tmp, size)
+        if seg is None:
+            seg = _Segment(tmp, size, create=True)
 
         def commit() -> memoryview:
             os.rename(tmp, final)

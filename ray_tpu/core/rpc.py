@@ -110,9 +110,14 @@ class RpcServer:
     def register(self, name: str, fn):
         self.handlers[name] = fn
 
+    # StreamReader buffer limit: the default 64 KiB forces a transport
+    # pause/resume cycle every ~128 KiB, capping large-frame throughput at
+    # ~0.2 GiB/s; object-plane chunks are 8 MiB.
+    STREAM_LIMIT = 64 * 1024 * 1024
+
     async def start(self):
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port
+            self._on_client, self.host, self.port, limit=self.STREAM_LIMIT
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
@@ -196,7 +201,7 @@ class RpcClient:
 
     async def _connect(self):
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+            self.host, self.port, limit=RpcServer.STREAM_LIMIT
         )
         self._write_lock = asyncio.Lock()
         self._reader_task = asyncio.get_running_loop().create_task(
