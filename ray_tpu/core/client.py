@@ -305,8 +305,39 @@ class Client:
             inline = desc.get("inline")
             if inline is not None and desc.get("error") is None:
                 self._local_put(oid, inline)
-            out.append(self._materialize(oid, desc))
+            try:
+                out.append(self._materialize(oid, desc))
+            except exceptions.ObjectReconstructionFailedError:
+                raise
+            except exceptions.ObjectLostError:
+                out.append(self._recover_and_get(oid, timeout))
         return out
+
+    def _recover_and_get(self, oid: ObjectID, timeout: float):
+        """Every known copy of the object is gone: ask the head to recompute
+        it from lineage, then wait for the re-seal and re-read (reference:
+        object_recovery_manager.h:90)."""
+        for attempt in range(3):
+            if attempt:
+                # The sole-copy node may be dead but not yet declared (its
+                # head connection can linger); give the health prober time
+                # to reap it so the head drops the stale location.
+                time.sleep(0.5 * (2 ** (attempt - 1)))
+            self.call("reconstruct_object", {"object_id": oid.binary()})
+            desc = self.get_raw([oid], timeout)[0]
+            if desc.get("timeout"):
+                raise exceptions.GetTimeoutError(
+                    f"ray_tpu.get timed out awaiting reconstruction of {oid}"
+                )
+            try:
+                return self._materialize(oid, desc)
+            except exceptions.ObjectReconstructionFailedError:
+                raise
+            except exceptions.ObjectLostError:
+                continue  # lost again mid-recovery (another node died)
+        raise exceptions.ObjectLostError(
+            f"object {oid} kept vanishing during reconstruction"
+        )
 
     def _materialize(self, oid: ObjectID, desc: dict) -> Any:
         if desc.get("error") is not None:

@@ -65,6 +65,10 @@ class Config:
     worker_pool_hard_cap_multiple: int = 4
     # -- fault tolerance ------------------------------------------------------
     default_task_max_retries: int = 3
+    # Finished task specs kept for object lineage reconstruction (their args
+    # stay pinned while kept — the analog of the reference's lineage pinning,
+    # reference_count.h:75).  0 disables reconstruction.
+    lineage_max_entries: int = 10_000
     default_actor_max_restarts: int = 0
     # Liveness probing of worker/node processes whose TCP connection is still
     # open but whose event loop has wedged (reference:

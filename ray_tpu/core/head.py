@@ -24,7 +24,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set
 
 from . import serialization
@@ -221,6 +221,11 @@ class Head:
         self.pending_pgs: "Dict[PlacementGroupID, dict]" = {}
         self._pending_frees: Dict[int, dict] = {}
         self._free_token = 0
+        # Lineage: finished task specs kept (args pinned) so lost objects can
+        # be recomputed by re-running their creating task (reference:
+        # object_recovery_manager.h:90, reference_count.h:75).
+        self.lineage: "OrderedDict[TaskID, dict]" = OrderedDict()
+        self.reconstruction_counts: Dict[TaskID, int] = {}
         self.pg_waiters: Dict[PlacementGroupID, List[asyncio.Event]] = {}
         self._periodic_task: Optional[asyncio.Task] = None
         self._tick_task: Optional[asyncio.Task] = None
@@ -234,7 +239,7 @@ class Head:
             "task_done", "stream_item", "put_object", "put_object_batch",
             "get_objects",
             "wait_objects", "free_objects", "object_free_ack",
-            "add_object_ref",
+            "add_object_ref", "reconstruct_object",
             "create_placement_group", "remove_placement_group",
             "kill_actor", "cancel_task", "get_actor_by_name", "list_named_actors",
             "worker_ready",
@@ -641,9 +646,16 @@ class Head:
                     task.parked_node = None
                     self.queued_tasks.append(task)
             # Objects whose only copy lived there are gone; purge locations
-            # so readers fail fast (lineage reconstruction can then kick in).
-            for rec in self.objects.values():
-                rec.locations.discard(node_id)
+            # and recompute referenced ones from lineage (reference:
+            # object_recovery_manager.h:90 recovers on location loss).
+            lost: List[ObjectID] = []
+            for o, rec in self.objects.items():
+                if node_id in rec.locations:
+                    rec.locations.discard(node_id)
+                    if rec.sealed and rec.inline is None and not rec.locations:
+                        lost.append(o)
+            for o in lost:
+                self._maybe_reconstruct(o)
             # The dead node may have had zero registered workers (the sticky-
             # placement case: parked task, worker still spawning) — the
             # per-worker death path below won't run, so kick explicitly for
@@ -769,6 +781,7 @@ class Head:
             rec.ref_count -= 1
             if rec.ref_count <= 0:
                 self.objects.pop(oid, None)
+                self._drop_lineage_for(oid)
                 items.append((raw, set(rec.locations)))
         if items:
             await self._deferred_free(items)
@@ -946,7 +959,14 @@ class Head:
         reference_count.h:61)."""
         self.tasks[task.task_id] = task
         for raw in task.spec.get("return_ids", []):
-            self._obj(ObjectID(raw)).task_id = task.task_id
+            if task.spec.get("_reconstruct"):
+                # Freed sibling returns stay freed: resurrecting them via
+                # _obj would create unowned records nothing ever decrefs.
+                rec = self.objects.get(ObjectID(raw))
+                if rec is not None:
+                    rec.task_id = task.task_id
+            else:
+                self._obj(ObjectID(raw)).task_id = task.task_id
         for raw in task.spec.get("arg_ids", []):
             oid = ObjectID(raw)
             rec = self._obj(oid)
@@ -955,6 +975,140 @@ class Head:
                 task.pending_deps.add(oid)
                 self.tasks_waiting_on.setdefault(oid, set()).add(task.task_id)
 
+    def _lineage_eligible(self, task: TaskRecord) -> bool:
+        retries = task.spec.get(
+            "max_retries", self.config.default_task_max_retries
+        )
+        if not (
+            task.state == FINISHED
+            and self.config.lineage_max_entries > 0
+            and retries != 0  # max_retries=0: reconstruction disabled anyway
+            and not task.spec.get("actor_id")
+            and not task.spec.get("is_actor_creation")
+            and task.spec.get("num_returns") != "streaming"
+        ):
+            return False
+        # Inline returns live in head memory and survive node death — no
+        # reconstruction needed, so don't pin args for them.
+        return any(
+            ObjectID(raw) in self.objects
+            and self.objects[ObjectID(raw)].inline is None
+            for raw in task.spec.get("return_ids", [])
+        )
+
+    def _unpin_spec(self, spec: dict, include_args_ref: bool = True):
+        """Release the arg pins held by a lineage entry."""
+        for raw in spec.get("arg_ids", []):
+            self._decref(ObjectID(raw))
+        if include_args_ref and spec.get("args_ref") is not None:
+            self._decref(ObjectID(spec["args_ref"]))
+
+    def _drop_lineage_for(self, oid: ObjectID):
+        """Drop a task's lineage entry once none of its return objects are
+        referenced anymore (the entry exists to recompute exactly those)."""
+        tid = oid.task_id()
+        spec = self.lineage.get(tid)
+        if spec is None:
+            return
+        if any(ObjectID(raw) in self.objects
+               for raw in spec.get("return_ids", [])):
+            return
+        del self.lineage[tid]
+        self.reconstruction_counts.pop(tid, None)
+        self._unpin_spec(spec)
+
+    def _fail_object(self, oid: ObjectID, exc: Exception):
+        rec = self._obj(oid)
+        rec.error = serialization.pack(exc)
+        rec.sealed = True
+        self._notify_object_ready(oid)
+
+    def _maybe_reconstruct(self, oid: ObjectID, depth: int = 0) -> bool:
+        """Recompute a lost object by re-running its creating task (the
+        ObjectID embeds it).  Returns True when the object is available, in
+        flight, or now being reconstructed; False when it was failed with
+        ObjectReconstructionFailedError (reference:
+        object_recovery_manager.h:90 RecoverObject)."""
+        from ..exceptions import ObjectReconstructionFailedError
+
+        rec = self.objects.get(oid)
+        if rec is None:
+            return False  # freed: nothing to recover, nobody waiting
+        if rec.inline is not None or rec.locations:
+            return True
+        tid = oid.task_id()
+        live = self.tasks.get(tid)
+        if live is not None and live.state in (PENDING, RUNNING):
+            rec.sealed = False  # already being (re)computed: getters block
+            rec.error = None
+            return True
+        spec = self.lineage.get(tid)
+        if spec is None or depth > 8:
+            self._fail_object(oid, ObjectReconstructionFailedError(
+                f"object {oid.hex()} lost and "
+                + ("reconstruction depth limit reached" if spec is not None
+                   else "no lineage is available (task spec dropped, "
+                        "put object, or max_retries=0)")
+            ))
+            return False
+        retries = spec.get("max_retries", self.config.default_task_max_retries)
+        count = self.reconstruction_counts.get(tid, 0)
+        if retries >= 0 and count >= max(retries, 0):
+            self._fail_object(oid, ObjectReconstructionFailedError(
+                f"object {oid.hex()} lost and reconstruction attempts "
+                f"exhausted ({count}/{retries})"
+            ))
+            return False
+        self.reconstruction_counts[tid] = count + 1
+        # Unseal the still-referenced returns of the task (the re-run
+        # recomputes them); freed siblings stay freed — resurrecting them
+        # via _obj would create unowned records nothing ever decrefs.
+        for raw in spec.get("return_ids", []):
+            r = self.objects.get(ObjectID(raw))
+            if r is not None:
+                r.sealed = False
+                r.error = None
+        # Recursively recover lost inputs first (their specs are pinned by
+        # this entry); the resubmitted task dep-blocks on them via
+        # _register_task until they reseal.
+        for raw in spec.get("arg_ids", []):
+            self._maybe_reconstruct(ObjectID(raw), depth + 1)
+        if spec.get("args_ref") is not None:
+            self._maybe_reconstruct(ObjectID(spec["args_ref"]), depth + 1)
+        run_spec = spec
+        strat = spec.get("strategy")
+        if strat and strat.get("kind") == "node_affinity":
+            nid = NodeID(strat["node_id"])
+            node = self.scheduler.nodes.get(nid)
+            if node is None or not node.alive:
+                # The anchor died with the object; a hard affinity would make
+                # the re-run unschedulable forever.
+                run_spec = {**spec, "strategy": None}
+        if run_spec is spec:
+            run_spec = dict(spec)
+        run_spec["_reconstruct"] = True
+        task = TaskRecord(run_spec)
+        self._register_task(task)
+        self._event("task_reconstruction", task=tid.hex(),
+                    object=oid.hex(), attempt=count + 1)
+        if not task.pending_deps:
+            self.queued_tasks.append(task)
+        self._kick()
+        return True
+
+    async def h_reconstruct_object(self, conn, body):
+        """Client-requested recovery (its pull found every location gone)."""
+        oid = ObjectID(body["object_id"])
+        rec = self.objects.get(oid)
+        if rec is not None and rec.sealed and not rec.inline:
+            # Drop locations the client proved stale (node died unannounced).
+            dead = {
+                loc for loc in rec.locations
+                if loc != self.local_node_id and loc not in self.node_daemons
+            }
+            rec.locations -= dead
+        return {"queued": self._maybe_reconstruct(oid)}
+
     def _decref(self, oid: ObjectID):
         rec = self.objects.get(oid)
         if rec is None:
@@ -962,6 +1116,7 @@ class Head:
         rec.ref_count -= 1
         if rec.ref_count <= 0:
             self.objects.pop(oid, None)
+            self._drop_lineage_for(oid)
             asyncio.ensure_future(
                 self._deferred_free([(oid.binary(), set(rec.locations))])
             )
@@ -977,20 +1132,37 @@ class Head:
                     self.tasks_waiting_on.pop(oid, None)
 
     def _finalize_task(self, task: TaskRecord):
-        """Terminal-state cleanup: unpin args, prune the record."""
-        self._unpin_task_args(task)
-        # The large-args spill object is pinned only by its creation
-        # reference; it dies with the task — except for the creation task of
-        # a live actor, whose restart resubmits the same spec and must be
-        # able to re-read the args (freed at permanent actor death instead).
-        args_ref = task.spec.get("args_ref")
-        if args_ref is not None:
-            keep = False
-            if task.spec.get("is_actor_creation"):
-                actor = self.actors.get(ActorID(task.spec["actor_id"]))
-                keep = actor is not None and actor.state != "DEAD"
-            if not keep:
-                self._decref(ObjectID(args_ref))
+        """Terminal-state cleanup: either transfer the task's arg pins to a
+        lineage entry (so a lost output can be recomputed by re-running the
+        spec — reference: reference_count.h:75 lineage pinning) or unpin."""
+        if self._lineage_eligible(task):
+            old = self.lineage.pop(task.task_id, None)
+            self.lineage[task.task_id] = task.spec
+            if old is not None:
+                # Re-recorded after reconstruction: the fresh registration
+                # re-pinned arg_ids (but never args_ref — _register_task
+                # doesn't pin it), so release only the re-pinned part.
+                self._unpin_spec(old, include_args_ref=False)
+            while len(self.lineage) > self.config.lineage_max_entries:
+                etid, evicted = self.lineage.popitem(last=False)
+                self.reconstruction_counts.pop(etid, None)
+                self._unpin_spec(evicted)
+        else:
+            self._unpin_task_args(task)
+            # The large-args spill object is pinned only by its creation
+            # reference; it dies with the task — except for the creation task
+            # of a live actor, whose restart resubmits the same spec and must
+            # be able to re-read the args (freed at permanent actor death).
+            args_ref = task.spec.get("args_ref")
+            # A lineage entry for this task still holds the args_ref pin
+            # (e.g. a failed reconstruction re-run): leave it to the entry.
+            if args_ref is not None and task.task_id not in self.lineage:
+                keep = False
+                if task.spec.get("is_actor_creation"):
+                    actor = self.actors.get(ActorID(task.spec["actor_id"]))
+                    keep = actor is not None and actor.state != "DEAD"
+                if not keep:
+                    self._decref(ObjectID(args_ref))
         self.finished_tasks.append(
             {
                 "task_id": task.task_id.hex(),
@@ -1229,6 +1401,21 @@ class Head:
             task.error = body.get("error_repr", "")
         for ret in body.get("returns", []):
             oid = ObjectID(ret["object_id"])
+            if task.spec.get("_reconstruct") and oid not in self.objects:
+                # A freed sibling recomputed during reconstruction: nobody
+                # references it — drop the stored copy instead of
+                # resurrecting the record (mirrors the from_pull guard).
+                if not failed and ret.get("inline") is None and worker:
+                    self._adopt_local(oid, worker.node_id)
+                    if worker.node_id == self.local_node_id:
+                        self.store.free(oid)
+                    else:
+                        daemon = self.node_daemons.get(worker.node_id)
+                        if daemon is not None:
+                            asyncio.ensure_future(daemon.push(
+                                "free_objects", {"object_ids": [ret["object_id"]]}
+                            ))
+                continue
             rec = self._obj(oid)
             if failed:
                 rec.error = body["error"]

@@ -41,7 +41,7 @@ from .rpc import RpcClient, RpcServer, ServerThread
 
 PULL_CHUNK_BYTES = 8 * 1024 * 1024
 
-# Bulk-channel wire format: request = object_id(16) | offset u64 | length u64;
+# Bulk-channel wire format: request = object_id | offset u64 | length u64;
 # response = u64 byte count (NOT_FOUND sentinel if the object is gone)
 # followed by that many raw bytes (server-side os.sendfile from the shm
 # segment — zero user-space copies).
@@ -84,13 +84,14 @@ class BulkServer(threading.Thread):
         from .object_store import _seg_path
 
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        id_len = ObjectID.byte_len()
         try:
             while True:
-                hdr = _recv_exact(conn, 32)
+                hdr = _recv_exact(conn, id_len + 16)
                 if hdr is None:
                     return
-                oid = ObjectID(hdr[:16])
-                offset, length = struct.unpack_from("<QQ", hdr, 16)
+                oid = ObjectID(hdr[:id_len])
+                offset, length = struct.unpack_from("<QQ", hdr, id_len)
                 # Pin first: a concurrent spill between get() and the open
                 # below would unlink the segment and fail a live object.
                 # The puller holds a reference so a free can't race us; pin

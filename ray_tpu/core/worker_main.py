@@ -100,8 +100,9 @@ class Worker:
     def _resolve_args(self, spec) -> tuple:
         if spec.get("args_ref") is not None:
             oid = ObjectID(spec["args_ref"])
-            desc = self.client.get_raw([oid])[0]
-            args, kwargs = self.client._materialize(oid, desc)
+            # Through get(): local-store hits and lost-object recovery apply
+            # to spilled-arg payloads just like user-level gets.
+            args, kwargs = self.client.get([ObjectRef(oid, owned=False)])[0]
         else:
             args, kwargs = serialization.unpack(spec["args"])
         # Resolve top-level refs to values.

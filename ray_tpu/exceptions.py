@@ -45,13 +45,15 @@ class ActorDiedError(RayTpuError):
         return (type(self), (self.actor_id_hex, self.cause))
 
 
-class ObjectReconstructionFailedError(RayTpuError):
-    """Lineage reconstruction was attempted for a lost object but failed
-    (depth limit, missing lineage, or the re-executed task failed)."""
-
-
 class ObjectLostError(RayTpuError):
     pass
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    """Lineage reconstruction was attempted for a lost object but failed
+    (no lineage, retries exhausted, depth limit, or the re-executed task
+    failed) — a subtype of ObjectLostError so callers handling loss
+    generically keep working (reference: object_recovery_manager.h:90)."""
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

@@ -134,7 +134,21 @@ def test_actor_restart_on_node_death(cluster):
     assert ray_tpu.get(a.node.remote(), timeout=60) != n1.hex
 
 
-def test_object_lost_when_sole_copy_node_dies(cluster):
+def test_object_reconstructed_when_sole_copy_node_dies(cluster):
+    """Lineage reconstruction: the creating task is re-run when the only
+    copy dies with its node (reference: object_recovery_manager.h:90)."""
+    n1 = cluster.add_node(num_cpus=2)
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n1.hex),
+    ).remote(150_000)
+    ray_tpu.wait([ref], num_returns=1, timeout=30)
+    cluster.remove_node(n1)
+    arr = ray_tpu.get(ref, timeout=60)
+    assert len(arr) == 150_000 and int(arr[-1]) == 149_999
+
+
+def test_object_lost_when_sole_copy_node_dies_no_retries(cluster):
+    """max_retries=0 disables reconstruction: the loss surfaces."""
     n1 = cluster.add_node(num_cpus=2)
     ref = produce.options(
         scheduling_strategy=NodeAffinitySchedulingStrategy(n1.hex),
@@ -144,6 +158,24 @@ def test_object_lost_when_sole_copy_node_dies(cluster):
     cluster.remove_node(n1)
     with pytest.raises(exceptions.ObjectLostError):
         ray_tpu.get(ref, timeout=30)
+
+
+def test_reconstruction_recursive_through_lost_dependency(cluster):
+    """A lost object whose input was also lost recovers both: the dependency
+    is recomputed first, then the dependent (reference: recovery walks the
+    lineage graph through ReferenceCounter)."""
+    @ray_tpu.remote
+    def double(arr):
+        return arr * 2  # large output: lives in shm on the executing node
+
+    n1 = cluster.add_node(num_cpus=2)
+    strat = NodeAffinitySchedulingStrategy(n1.hex)
+    base = produce.options(scheduling_strategy=strat).remote(20_000)
+    derived = double.options(scheduling_strategy=strat).remote(base)
+    ray_tpu.wait([base, derived], num_returns=2, timeout=30)
+    cluster.remove_node(n1)
+    arr = ray_tpu.get(derived, timeout=60)
+    assert int(arr[-1]) == 2 * 19_999
 
 
 def test_placement_group_bundle_replaced_on_node_death(cluster):
