@@ -186,8 +186,10 @@ def _start_free_flusher():
     lineage pins) until the 16-entry batch fills or shutdown."""
     from .object_ref import _flush_free_queue
 
+    client = ctx.client  # this session's client: the thread dies with it
+
     def loop():
-        while ctx.initialized:
+        while ctx.initialized and ctx.client is client:
             time.sleep(0.5)
             try:
                 _flush_free_queue(background=True)

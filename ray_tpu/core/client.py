@@ -317,6 +317,7 @@ class Client:
         """Every known copy of the object is gone: ask the head to recompute
         it from lineage, then wait for the re-seal and re-read (reference:
         object_recovery_manager.h:90)."""
+        deadline = None if timeout < 0 else time.monotonic() + timeout
         for attempt in range(3):
             if attempt:
                 # The sole-copy node may be dead but not yet declared (its
@@ -324,7 +325,11 @@ class Client:
                 # to reap it so the head drops the stale location.
                 time.sleep(0.5 * (2 ** (attempt - 1)))
             self.call("reconstruct_object", {"object_id": oid.binary()})
-            desc = self.get_raw([oid], timeout)[0]
+            remaining = (
+                -1.0 if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            desc = self.get_raw([oid], remaining)[0]
             if desc.get("timeout"):
                 raise exceptions.GetTimeoutError(
                     f"ray_tpu.get timed out awaiting reconstruction of {oid}"

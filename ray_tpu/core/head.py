@@ -1060,12 +1060,14 @@ class Head:
             ))
             return False
         self.reconstruction_counts[tid] = count + 1
-        # Unseal the still-referenced returns of the task (the re-run
+        # Unseal the still-referenced LOST returns of the task (the re-run
         # recomputes them); freed siblings stay freed — resurrecting them
-        # via _obj would create unowned records nothing ever decrefs.
+        # via _obj would create unowned records nothing ever decrefs — and
+        # siblings with a live copy (or inline data) must stay readable
+        # (a failed re-run must not overwrite them with an error).
         for raw in spec.get("return_ids", []):
             r = self.objects.get(ObjectID(raw))
-            if r is not None:
+            if r is not None and r.inline is None and not r.locations:
                 r.sealed = False
                 r.error = None
         # Recursively recover lost inputs first (their specs are pinned by
@@ -1418,6 +1420,10 @@ class Head:
                 continue
             rec = self._obj(oid)
             if failed:
+                if rec.sealed and (rec.inline is not None or rec.locations):
+                    # A live sibling a reconstruction re-run didn't need:
+                    # the failure must not clobber its valid data.
+                    continue
                 rec.error = body["error"]
             elif ret.get("inline") is not None:
                 rec.error = None  # e.g. re-sealed by a restarted actor creation

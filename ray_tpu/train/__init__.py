@@ -17,6 +17,7 @@ from .session import (
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    get_mesh,
     report,
 )
 from .trainer import DataParallelTrainer, JaxTrainer, TrainingFailedError
@@ -25,5 +26,6 @@ __all__ = [
     "Checkpoint", "CheckpointManager", "save_pytree", "load_pytree",
     "RunConfig", "ScalingConfig", "FailureConfig", "CheckpointConfig",
     "Result", "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "get_mesh",
     "DataParallelTrainer", "JaxTrainer", "TrainingFailedError",
 ]

@@ -23,10 +23,23 @@ class ScalingConfig:
     num_workers: int = 1
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
-    # Mesh over each worker's visible devices (single-host) or over the whole
-    # pod after jax.distributed init (multi-host gang).
+    # Mesh built in every worker at setup (exposed via
+    # ray_tpu.train.get_mesh()): over the worker's local devices, or over the
+    # whole pod when jax_distributed bootstraps first (multi-host gang).
     mesh: Optional[MeshConfig] = None
+    # Run jax.distributed.initialize across the gang before building the
+    # mesh (the analog of _setup_torch_process_group, reference:
+    # train/torch/config.py:66).  None = auto: multi-worker TPU gangs only
+    # (multi-process CPU meshes aren't supported by JAX).
+    jax_distributed: Optional[bool] = None
+    # Per-worker runtime env (e.g. env_vars setting XLA flags).
+    runtime_env: Optional[dict] = None
     placement_strategy: str = "PACK"
+
+    def wants_jax_distributed(self) -> bool:
+        if self.jax_distributed is not None:
+            return self.jax_distributed
+        return self.use_tpu and self.num_workers > 1
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

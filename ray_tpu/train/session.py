@@ -36,6 +36,12 @@ class TrainSession:
         self.trial_dir = trial_dir
         self.restored_checkpoint = restored_checkpoint
         self.dataset_shards = dataset_shards or {}
+        # Set at worker setup when ScalingConfig.mesh is given: the jax Mesh
+        # every rank shards its train step over (ray_tpu.train.get_mesh()).
+        self.mesh = None
+        # Name of the gang's host-side collective group (cross-worker
+        # allreduce of metrics/grads outside compiled programs).
+        self.collective_group: Optional[str] = None
         self.result_queue: "queue.Queue" = queue.Queue()
         self.consumed = threading.Semaphore(0)
         self.step = 0
@@ -119,6 +125,12 @@ def get_checkpoint() -> Optional[Checkpoint]:
 
 def get_dataset_shard(name: str = "train"):
     return get_session().get_dataset_shard(name)
+
+
+def get_mesh():
+    """The jax.sharding.Mesh built from ScalingConfig.mesh for this worker
+    (None when the trainer was not configured with a mesh)."""
+    return get_session().mesh
 
 
 class TrainContext:

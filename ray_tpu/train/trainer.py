@@ -89,6 +89,9 @@ class DataParallelTrainer:
                 self.scaling_config.worker_resources(),
                 trial_dir,
                 self.scaling_config.placement_strategy,
+                mesh_config=self.scaling_config.mesh,
+                jax_distributed=self.scaling_config.wants_jax_distributed(),
+                runtime_env=self.scaling_config.runtime_env,
             )
             try:
                 shards = self._make_dataset_shards()

@@ -19,6 +19,37 @@ from . import session as session_mod
 from .checkpoint import Checkpoint
 
 
+def _dumps_by_value(fn) -> bytes:
+    """Serialize a user train loop so workers never need to import its
+    defining module: driver scripts and test files are typically not
+    importable from worker processes (pytest imports test files as top-level
+    modules; ad-hoc scripts are __main__).  Modules inside installed
+    packages keep by-reference semantics."""
+    import sys
+
+    mod = sys.modules.get(getattr(fn, "__module__", None))
+    by_value = False
+    if mod is not None and mod.__name__ not in ("__main__",):
+        mod_file = getattr(mod, "__file__", "") or ""
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        in_site = "site-packages" in mod_file or "dist-packages" in mod_file
+        in_framework = mod_file.startswith(os.path.join(pkg_dir, ""))
+        if mod_file and not in_site and not in_framework:
+            try:
+                cloudpickle.register_pickle_by_value(mod)
+                by_value = True
+            except Exception:
+                by_value = False
+    try:
+        return cloudpickle.dumps(fn)
+    finally:
+        if by_value:
+            try:
+                cloudpickle.unregister_pickle_by_value(mod)
+            except Exception:
+                pass
+
+
 @ray_tpu.remote(max_concurrency=4)
 class TrainWorker:
     """One rank of the gang.  max_concurrency lets poll()/ack() run while the
@@ -35,6 +66,9 @@ class TrainWorker:
         restored_ckpt_path: Optional[str],
         dataset_shards: Optional[Dict[str, Any]],
         collective_group: Optional[str],
+        mesh_config=None,
+        jax_distributed: bool = False,
+        gang_id: str = "",
     ):
         from . import session as smod
 
@@ -47,18 +81,38 @@ class TrainWorker:
             ),
             dataset_shards=dataset_shards,
         )
+        self.session.collective_group = collective_group
         if collective_group is not None:
             from ..collective import init_collective_group
 
             init_collective_group(
                 self.world_size, self.rank, group_name=collective_group
             )
+        if jax_distributed and self.world_size > 1:
+            # Gang SPMD bootstrap: after this, jax.devices() spans the whole
+            # pod and the mesh below is global (reference analog:
+            # _setup_torch_process_group runs on every worker in on_start,
+            # train/torch/config.py:66-153).  The gang id makes the KV
+            # coordinator key unique per WorkerGroup incarnation — a
+            # restarted gang must not read the dead attempt's address.
+            from ..parallel.distributed import initialize_process_group
+
+            initialize_process_group(
+                self.world_size, self.rank,
+                group_name=f"{collective_group or 'train'}-{gang_id}",
+            )
+        if mesh_config is not None:
+            from ..parallel.mesh import make_mesh
+
+            self.session.mesh = make_mesh(mesh_config)
         return self.rank
 
     def run(self, fn_blob: bytes, config: Optional[dict]):
-        """Execute the user train loop; always ends with a 'done' sentinel."""
-        fn = cloudpickle.loads(fn_blob)
+        """Execute the user train loop; always ends with a 'done' sentinel —
+        including when the loop fails to even deserialize (the driver polls
+        the session queue, so a raised-instead-of-queued error would hang it)."""
         try:
+            fn = cloudpickle.loads(fn_blob)
             if config is not None:
                 fn(config)
             else:
@@ -89,9 +143,15 @@ class TrainWorker:
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
-                 trial_dir: str, placement_strategy: str = "PACK"):
+                 trial_dir: str, placement_strategy: str = "PACK",
+                 mesh_config=None, jax_distributed: bool = False,
+                 runtime_env: Optional[dict] = None):
         self.num_workers = num_workers
         self.trial_dir = trial_dir
+        self.mesh_config = mesh_config
+        self.jax_distributed = jax_distributed
+        self.runtime_env = runtime_env
+        self.gang_id = os.urandom(4).hex()
         self.pg = None
         if num_workers > 1:
             import warnings
@@ -111,6 +171,8 @@ class WorkerGroup:
         opts: Dict[str, Any] = {"num_cpus": resources_per_worker.get("CPU", 1)}
         if resources_per_worker.get("TPU"):
             opts["num_tpus"] = resources_per_worker["TPU"]
+        if runtime_env:
+            opts["runtime_env"] = runtime_env
         self.workers: List[Any] = []
         for rank in range(num_workers):
             cls = TrainWorker
@@ -136,13 +198,16 @@ class WorkerGroup:
                 restored_ckpt,
                 dataset_shards[i] if dataset_shards else None,
                 collective_group,
+                self.mesh_config,
+                self.jax_distributed,
+                self.gang_id,
             )
             for i, w in enumerate(self.workers)
         ]
         return ray_tpu.get(refs)
 
     def start_training(self, fn: Callable, config: Optional[dict]):
-        blob = cloudpickle.dumps(fn)
+        blob = _dumps_by_value(fn)
         self.run_refs = [w.run.remote(blob, config) for w in self.workers]
 
     def poll_all(self, ranks: Optional[List[int]] = None,
