@@ -1,0 +1,35 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Role-equivalent to Ray Tune (reference: python/ray/tune — Tuner,
+TuneController, search spaces, ASHA scheduler, experiment resume), scaled to
+the TPU-first framework: trials are actors, TPU trials reserve chips via
+resources_per_trial, and gang trials compose with ray_tpu.train inside the
+trainable.
+"""
+
+from .schedulers import ASHAScheduler, FIFOScheduler
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .tuner import (
+    Result,
+    ResultGrid,
+    TuneConfig,
+    TuneError,
+    TuneInterrupted,
+    Tuner,
+    get_trial_dir,
+    report,
+)
+
+__all__ = [
+    "Tuner", "TuneConfig", "TuneError", "TuneInterrupted",
+    "Result", "ResultGrid", "report", "get_trial_dir",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "sample_from", "ASHAScheduler", "FIFOScheduler",
+]

@@ -1,0 +1,97 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Role-equivalent to the reference's FIFOScheduler and AsyncHyperBandScheduler
+(reference: tune/schedulers/trial_scheduler.py, async_hyperband.py:36 — the
+asynchronous successive-halving algorithm: rungs at grace_period *
+reduction_factor^k; a trial reaching a rung continues only if its metric is
+in the top 1/reduction_factor of results recorded at that rung).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """Run every trial to completion."""
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial_id: str, result: Dict) -> None:
+        pass
+
+
+class _Rung:
+    __slots__ = ("t", "recorded")
+
+    def __init__(self, t: float):
+        self.t = t
+        self.recorded: Dict[str, float] = {}
+
+    def cutoff(self, reduction_factor: float):
+        """Values are normalized bigger-is-better; a trial survives the rung
+        only if its value is >= the (1 - 1/rf) quantile of recorded values
+        (keep the top 1/rf fraction — reference: async_hyperband.py cutoff
+        via nanpercentile)."""
+        values = sorted(self.recorded.values())
+        k = int(math.floor(len(values) * (1 - 1 / reduction_factor)))
+        if k <= 0:
+            return None
+        return values[min(k, len(values) - 1)]
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving (reference: async_hyperband.py:36)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 3,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.reduction_factor = reduction_factor
+        self.rungs: List[_Rung] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(_Rung(t))
+            t *= reduction_factor
+        self.rungs.reverse()  # highest rung first (match a trial's furthest)
+
+    def _value(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v  # normalize to bigger=better
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        if self.metric not in result or self.time_attr not in result:
+            return CONTINUE
+        t = result[self.time_attr]
+        value = self._value(result)
+        decision = CONTINUE
+        for rung in self.rungs:
+            if t < rung.t or trial_id in rung.recorded:
+                continue
+            rung.recorded[trial_id] = value
+            cutoff = rung.cutoff(self.reduction_factor)
+            if cutoff is not None and value < cutoff:
+                decision = STOP
+            break  # only the highest newly-reached rung counts
+        return decision
+
+    def on_complete(self, trial_id: str, result: Dict) -> None:
+        if result and self.metric in result and self.time_attr in result:
+            for rung in self.rungs:
+                if result[self.time_attr] >= rung.t \
+                        and trial_id not in rung.recorded:
+                    rung.recorded[trial_id] = self._value(result)

@@ -1,0 +1,143 @@
+"""Search spaces + variant generation.
+
+Role-equivalent to the reference's tune search-space API and
+BasicVariantGenerator (reference: python/ray/tune/search/sample.py —
+grid_search/choice/uniform/randint; search/basic_variant.py — grid
+cross-product x num_samples random sampling).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Sequence
+
+
+class Domain:
+    """A sampled hyperparameter dimension."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+class LogUniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        import math
+
+        self.log_lower, self.log_upper = math.log(lower), math.log(upper)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.log_lower, self.log_upper))
+
+
+class RandInt(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower: float, upper: float) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> LogUniform:
+    return LogUniform(lower, upper)
+
+
+def randint(lower: int, upper: int) -> RandInt:
+    return RandInt(lower, upper)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, Any]:
+    """Marker for exhaustive expansion (one trial per value, crossed with
+    every other grid dimension; reference: tune/search/sample.py grid_search)."""
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _collect_grids(space: Dict[str, Any], path=()) -> List[tuple]:
+    """All grid_search dimensions in a (possibly nested) space as
+    (key-path, values) pairs."""
+    out = []
+    for k, v in space.items():
+        if _is_grid(v):
+            out.append((path + (k,), v["grid_search"]))
+        elif isinstance(v, dict):
+            out.extend(_collect_grids(v, path + (k,)))
+    return out
+
+
+def _resolve(space: Dict[str, Any], grid_assign: Dict[tuple, Any],
+             rng: random.Random, path=()) -> Dict[str, Any]:
+    cfg: Dict[str, Any] = {}
+    for k, v in space.items():
+        p = path + (k,)
+        if _is_grid(v):
+            cfg[k] = grid_assign[p]
+        elif isinstance(v, Domain):
+            cfg[k] = v.sample(rng)
+        elif isinstance(v, dict):
+            cfg[k] = _resolve(v, grid_assign, rng, p)
+        else:
+            cfg[k] = v
+    return cfg
+
+
+def generate_variants(
+    param_space: Dict[str, Any],
+    num_samples: int = 1,
+    seed: int = 0,
+) -> List[Dict[str, Any]]:
+    """Expand a param space into concrete trial configs: the cross-product
+    of all grid dimensions (nested dicts included), repeated num_samples
+    times with random dimensions re-sampled each repeat
+    (reference: basic_variant.py)."""
+    rng = random.Random(seed)
+    grids = _collect_grids(param_space)
+    grid_paths = [p for p, _ in grids]
+    grid_values = [vals for _, vals in grids]
+    variants: List[Dict[str, Any]] = []
+    for _ in range(max(1, num_samples)):
+        for combo in itertools.product(*grid_values) if grids else [()]:
+            assign = dict(zip(grid_paths, combo))
+            variants.append(_resolve(param_space, assign, rng))
+    return variants

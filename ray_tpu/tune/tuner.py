@@ -1,0 +1,458 @@
+"""Tuner + trial controller.
+
+Role-equivalent to the reference's Tuner / TuneController event loop
+(reference: tune/tuner.py:44, tune/execution/tune_controller.py:68 step:666)
+over trial actors, with experiment state snapshots + resume
+(tune/execution/experiment_state.py, Tuner.restore).
+
+Function trainables report via ray_tpu.tune.report(...) (reference:
+tune/trainable/function_trainable.py session) or by returning a final
+metrics dict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ..exceptions import RayTpuError
+from ..train.config import RunConfig
+from ..train.worker_group import _dumps_by_value
+from .schedulers import CONTINUE, STOP, FIFOScheduler
+from .search import generate_variants
+
+PENDING, RUNNING, TERMINATED, ERROR, STOPPED = (
+    "PENDING", "RUNNING", "TERMINATED", "ERROR", "STOPPED",
+)
+
+
+class TuneError(RayTpuError):
+    pass
+
+
+class TuneInterrupted(TuneError):
+    """fit() was aborted; the experiment state on disk supports restore()."""
+
+
+# ---------------------------------------------------------------- session
+
+
+class _StopTrial(BaseException):
+    """Raised inside the trainable when the scheduler stops the trial."""
+
+
+class _TrialSession:
+    def __init__(self, trial_id: str, trial_dir: str):
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self.queue: "queue.Queue" = queue.Queue()
+        self.iteration = 0
+        self.stop_requested = False
+
+    def report(self, metrics: Dict[str, Any]):
+        self.iteration += 1
+        out = dict(metrics)
+        out.setdefault("training_iteration", self.iteration)
+        self.queue.put({"kind": "report", "metrics": out})
+        if self.stop_requested:
+            raise _StopTrial()
+
+
+_session: Optional[_TrialSession] = None
+
+
+def report(metrics: Dict[str, Any]) -> None:
+    """Report intermediate metrics from inside a trial (reference:
+    ray.tune.report / session.report)."""
+    if _session is None:
+        raise RuntimeError("tune.report() called outside a Tuner trial")
+    _session.report(metrics)
+
+
+def get_trial_dir() -> str:
+    if _session is None:
+        raise RuntimeError("not inside a Tuner trial")
+    return _session.trial_dir
+
+
+@ray_tpu.remote(max_concurrency=4)
+class _TrialRunner:
+    """Hosts one trial's function trainable; reports stream through poll()."""
+
+    def __init__(self):
+        self._session: Optional[_TrialSession] = None
+
+    def run(self, fn_blob: bytes, config: dict, trial_id: str,
+            trial_dir: str):
+        global _session
+        import ray_tpu.tune.tuner as tuner_mod
+
+        sess = _TrialSession(trial_id, trial_dir)
+        self._session = sess
+        tuner_mod._session = sess
+        final: Dict[str, Any] = {}
+        try:
+            fn = cloudpickle.loads(fn_blob)
+            out = fn(config)
+            if isinstance(out, dict):
+                out.setdefault("training_iteration", sess.iteration + 1)
+                final = out
+                sess.queue.put({"kind": "report", "metrics": out})
+            sess.queue.put({"kind": "done", "status": TERMINATED,
+                            "final": final})
+        except _StopTrial:
+            sess.queue.put({"kind": "done", "status": STOPPED, "final": {}})
+        except BaseException as e:  # noqa: BLE001 — relayed to the driver
+            import traceback
+
+            sess.queue.put({
+                "kind": "done", "status": ERROR,
+                "error": f"{e}\n{traceback.format_exc()}",
+            })
+
+    def poll(self) -> List[dict]:
+        out: List[dict] = []
+        if self._session is None:
+            return out
+        while True:
+            try:
+                out.append(self._session.queue.get_nowait())
+            except queue.Empty:
+                return out
+
+    def request_stop(self):
+        if self._session is not None:
+            self._session.stop_requested = True
+        return True
+
+
+# ------------------------------------------------------------------ trials
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = PENDING
+        self.last_result: Dict[str, Any] = {}
+        self.error: Optional[str] = None
+        self.actor = None
+        self.run_ref = None
+
+    def to_json(self) -> dict:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": self.status,
+            "last_result": self.last_result,
+            "error": self.error,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Trial":
+        t = cls(d["trial_id"], d["config"])
+        t.status = d["status"]
+        t.last_result = d.get("last_result", {})
+        t.error = d.get("error")
+        return t
+
+
+class Result:
+    def __init__(self, trial: Trial):
+        self.config = trial.config
+        self.metrics = trial.last_result
+        self.error = trial.error
+        self.trial_id = trial.trial_id
+        self.status = trial.status
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i) -> Result:
+        return Result(self._trials[i])
+
+    def __iter__(self):
+        return (Result(t) for t in self._trials)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise TuneError("no metric given (TuneConfig.metric or argument)")
+        best = None
+        for t in self._trials:
+            if metric not in t.last_result:
+                continue
+            v = t.last_result[metric]
+            if best is None or (v > best[0] if mode == "max" else v < best[0]):
+                best = (v, t)
+        if best is None:
+            raise TuneError(f"no trial reported metric {metric!r}")
+        return Result(best[1])
+
+    def get_dataframe(self):
+        rows = [
+            {"trial_id": t.trial_id, "status": t.status,
+             **{f"config/{k}": v for k, v in t.config.items()},
+             **t.last_result}
+            for t in self._trials
+        ]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+
+# ------------------------------------------------------------------- config
+
+
+class TuneConfig:
+    """(reference: tune/tune_config.py TuneConfig)"""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: str = "min",
+        num_samples: int = 1,
+        max_concurrent_trials: Optional[int] = None,
+        scheduler=None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        seed: int = 0,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_concurrent_trials = max_concurrent_trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.resources_per_trial = resources_per_trial or {"CPU": 1}
+        self.seed = seed
+
+
+# -------------------------------------------------------------------- Tuner
+
+
+class Tuner:
+    """(reference: tune/tuner.py:44 Tuner; fit -> tune_controller loop)"""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        _restored_trials: Optional[List[Trial]] = None,
+        _experiment_dir: Optional[str] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._trials = _restored_trials
+        self._experiment_dir = _experiment_dir
+        # Test hook / Ctrl-C analog: set to interrupt fit() with state saved.
+        self._abort = threading.Event()
+
+    # -- state ------------------------------------------------------------
+
+    def _state_path(self) -> str:
+        return os.path.join(self._experiment_dir, "tuner_state.json")
+
+    def _save_state(self):
+        state = {
+            "tune_config": {
+                "metric": self.tune_config.metric,
+                "mode": self.tune_config.mode,
+            },
+            "trials": [t.to_json() for t in self._trials],
+        }
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, self._state_path())
+        # Full config (scheduler, resources, concurrency) isn't JSON;
+        # pickle it alongside so restore() keeps the experiment's behavior.
+        cfg_path = os.path.join(self._experiment_dir, "tune_config.pkl")
+        if not os.path.exists(cfg_path):
+            try:
+                with open(cfg_path, "wb") as f:
+                    f.write(cloudpickle.dumps(self.tune_config))
+            except Exception:
+                pass
+
+    @classmethod
+    def restore(cls, experiment_dir: str, trainable: Callable,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment: finished trials keep their
+        results; pending/running ones run (again) (reference:
+        Tuner.restore + experiment_state.py)."""
+        with open(os.path.join(experiment_dir, "tuner_state.json")) as f:
+            state = json.load(f)
+        trials = [Trial.from_json(d) for d in state["trials"]]
+        for t in trials:
+            if t.status == RUNNING:  # interrupted mid-run: run again
+                t.status = PENDING
+        cfg = tune_config
+        if cfg is None:
+            cfg_path = os.path.join(experiment_dir, "tune_config.pkl")
+            try:
+                with open(cfg_path, "rb") as f:
+                    cfg = cloudpickle.loads(f.read())
+            except Exception:
+                cfg = TuneConfig(**state["tune_config"])
+        return cls(
+            trainable,
+            tune_config=cfg,
+            _restored_trials=trials,
+            _experiment_dir=experiment_dir,
+        )
+
+    # -- fit ---------------------------------------------------------------
+
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cfg = self.tune_config
+        if self._experiment_dir is None:
+            name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+            storage = self.run_config.storage_path or os.path.join(
+                tempfile.gettempdir(), "ray_tpu_results"
+            )
+            self._experiment_dir = os.path.join(storage, name)
+        os.makedirs(self._experiment_dir, exist_ok=True)
+        if self._trials is None:
+            variants = generate_variants(
+                self.param_space, cfg.num_samples, cfg.seed
+            )
+            self._trials = [
+                Trial(f"trial_{i:05d}", v) for i, v in enumerate(variants)
+            ]
+        self._save_state()
+
+        fn_blob = _dumps_by_value(self.trainable)
+        scheduler = cfg.scheduler
+        # Placement capacity across every requested resource dimension: an
+        # actor beyond capacity would never start and its poll would stall
+        # the controller.
+        cluster = ray_tpu.cluster_resources()
+        capacity = min(
+            (int(cluster.get(res, 0) // amt)
+             for res, amt in cfg.resources_per_trial.items() if amt > 0),
+            default=1,
+        )
+        capacity = max(1, capacity)
+        max_concurrent = min(
+            cfg.max_concurrent_trials or capacity, capacity
+        )
+        opts = {"num_cpus": cfg.resources_per_trial.get("CPU", 1)}
+        if cfg.resources_per_trial.get("TPU"):
+            opts["num_tpus"] = cfg.resources_per_trial["TPU"]
+
+        pending = [t for t in self._trials if t.status == PENDING]
+        running: List[Trial] = []
+        try:
+            while pending or running:
+                if self._abort.is_set():
+                    raise TuneInterrupted(
+                        f"experiment interrupted; restore from "
+                        f"{self._experiment_dir}"
+                    )
+                # Launch up to the concurrency cap (the controller loop —
+                # reference: tune_controller.py step:666).
+                while pending and len(running) < max_concurrent:
+                    trial = pending.pop(0)
+                    trial.actor = _TrialRunner.options(**opts).remote()
+                    trial_dir = os.path.join(
+                        self._experiment_dir, trial.trial_id
+                    )
+                    os.makedirs(trial_dir, exist_ok=True)
+                    trial.run_ref = trial.actor.run.remote(
+                        fn_blob, trial.config, trial.trial_id, trial_dir
+                    )
+                    trial.status = RUNNING
+                    self._save_state()
+                    running.append(trial)
+                # Drain reports per trial: one trial's dead worker (OOM,
+                # segfault) must fail that trial, not the experiment
+                # (reference: tune_controller handles trial-actor failure
+                # by erroring the trial).
+                still_running: List[Trial] = []
+                for trial in running:
+                    try:
+                        events = ray_tpu.get(
+                            trial.actor.poll.remote(), timeout=120
+                        )
+                    except RayTpuError as e:
+                        trial.status = ERROR
+                        trial.error = f"trial actor died: {e}"
+                        scheduler.on_complete(trial.trial_id,
+                                              trial.last_result)
+                        trial.actor = None
+                        self._save_state()
+                        continue
+                    finished = False
+                    for ev in events:
+                        if ev["kind"] == "report":
+                            trial.last_result = ev["metrics"]
+                            decision = scheduler.on_result(
+                                trial.trial_id, ev["metrics"]
+                            )
+                            if decision == STOP:
+                                try:
+                                    trial.actor.request_stop.remote()
+                                except Exception:
+                                    pass
+                        elif ev["kind"] == "done":
+                            finished = True
+                            trial.status = ev["status"]
+                            if ev.get("final"):
+                                trial.last_result = ev["final"]
+                            if ev.get("error"):
+                                trial.error = ev["error"]
+                            scheduler.on_complete(
+                                trial.trial_id, trial.last_result
+                            )
+                    if finished:
+                        ray_tpu.kill(trial.actor)
+                        trial.actor = None
+                        trial.run_ref = None
+                        self._save_state()
+                    else:
+                        still_running.append(trial)
+                running = still_running
+                if running:
+                    time.sleep(0.05)
+        finally:
+            for t in running:
+                if t.actor is not None:
+                    try:
+                        ray_tpu.kill(t.actor)
+                    except Exception:
+                        pass
+            self._save_state()
+        return ResultGrid(self._trials, cfg.metric, cfg.mode)

@@ -1,0 +1,216 @@
+"""Tune-equivalent tests: variant generation, controller, ASHA early
+stopping, and experiment resume after interruption.
+
+Reference analog: tune/tests/test_tune_controller.py,
+test_trial_scheduler.py (ASHA), test_tuner_restore.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_generate_variants_grid_and_random():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.grid_search([1, 2]),
+        "drop": tune.uniform(0, 1),
+        "fixed": 7,
+    }
+    variants = tune.tuner.generate_variants(space, num_samples=2, seed=1)
+    assert len(variants) == 8  # 2x2 grid x 2 samples
+    assert {(v["lr"], v["wd"]) for v in variants} == {
+        (0.1, 1), (0.1, 2), (0.01, 1), (0.01, 2)
+    }
+    assert all(v["fixed"] == 7 and 0 <= v["drop"] <= 1 for v in variants)
+
+
+def test_generate_variants_nested():
+    space = {"opt": {"lr": tune.uniform(0.1, 0.2),
+                     "name": tune.grid_search(["adam", "sgd"])}}
+    variants = tune.tuner.generate_variants(space, num_samples=1, seed=0)
+    assert len(variants) == 2
+    assert {v["opt"]["name"] for v in variants} == {"adam", "sgd"}
+    assert all(0.1 <= v["opt"]["lr"] <= 0.2 for v in variants)
+
+
+def _dying_fn(config):
+    if config["i"] == 1:
+        import os
+
+        os._exit(1)  # simulate a segfault/OOM the actor can't catch
+    return {"value": config["i"], "training_iteration": 1}
+
+
+def test_trial_actor_death_fails_only_that_trial(rt, tmp_path):
+    from ray_tpu.train import RunConfig
+
+    results = tune.Tuner(
+        _dying_fn,
+        param_space={"i": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="value", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    statuses = {r.config["i"]: r.status for r in results}
+    assert statuses[1] == "ERROR"
+    assert statuses[0] == statuses[2] == "TERMINATED"
+    assert results.get_best_result().metrics["value"] == 2
+
+
+def test_asha_stops_bad_trials_unit():
+    sched = tune.ASHAScheduler(metric="score", mode="max", max_t=27,
+                               grace_period=1, reduction_factor=3)
+    # 9 trials hit the first rung with descending scores 8..0: early strong
+    # reporters continue, later weak ones fall below the top-third cutoff.
+    decisions = [
+        sched.on_result(f"t{i}", {"score": 8 - i, "training_iteration": 1})
+        for i in range(9)
+    ]
+    assert decisions[0] == CONTINUE  # the best trial always survives
+    assert decisions[-1] == STOP  # the worst is cut
+    assert decisions.count(STOP) >= 4  # the bulk of weak trials got cut
+
+
+def _train_fn(config):
+    for i in range(10):
+        tune.report({"loss": config["lr"] * (10 - i)})
+    return {"loss": config["lr"], "training_iteration": 11}
+
+
+def test_tuner_grid_fifo(rt, tmp_path):
+    from ray_tpu.train import RunConfig
+
+    tuner = tune.Tuner(
+        _train_fn,
+        param_space={"lr": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.config["lr"] == 1.0
+    assert best.metrics["loss"] == 1.0
+
+
+def _asha_fn(config):
+    # Trial quality is its config value; bad trials plateau low.  The sleep
+    # paces reports so scheduler stop decisions land mid-run.
+    for i in range(1, 30):
+        tune.report({"score": config["q"] * (1 - 0.5 ** i)})
+        time.sleep(0.05)
+    return {"score": config["q"], "training_iteration": 30}
+
+
+def test_tuner_asha_early_stops(rt, tmp_path):
+    from ray_tpu.train import RunConfig
+
+    # Strong trials first: async halving can only cut a trial that reaches a
+    # rung after better contemporaries have set the cutoff.
+    tuner = tune.Tuner(
+        _asha_fn,
+        param_space={"q": tune.grid_search([7, 5, 3, 1, 6, 4, 2, 0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.ASHAScheduler(
+                metric="score", mode="max", max_t=30,
+                grace_period=2, reduction_factor=3,
+            ),
+            max_concurrent_trials=4,
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 8
+    statuses = [r.status for r in results]
+    assert "STOPPED" in statuses  # some trials were early-stopped
+    best = results.get_best_result()
+    assert best.config["q"] == 7
+
+
+def _slow_fn(config):
+    from ray_tpu.core.context import ctx
+
+    # Count executions cluster-side so the resume test can prove finished
+    # trials aren't re-run.
+    ctx.client.kv_put(f"ran:{config['i']}", b"1")
+    time.sleep(config.get("sleep", 0.0))
+    return {"value": config["i"], "training_iteration": 1}
+
+
+def test_tuner_interrupt_and_restore(rt, tmp_path):
+    from ray_tpu.train import RunConfig
+
+    tuner = tune.Tuner(
+        _slow_fn,
+        param_space={
+            "i": tune.grid_search(list(range(8))),
+            "sleep": 0.5,
+        },
+        tune_config=tune.TuneConfig(
+            metric="value", mode="max", max_concurrent_trials=2,
+        ),
+        run_config=RunConfig(name="resume_exp", storage_path=str(tmp_path)),
+    )
+    errors = []
+
+    def run():
+        try:
+            tuner.fit()
+        except tune.TuneInterrupted:
+            pass
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=run)
+    th.start()
+    # Wait until at least 2 trials finished, then interrupt (Ctrl-C analog).
+    deadline = time.time() + 60
+    exp_dir = str(tmp_path / "resume_exp")
+    import json, os
+
+    def n_done():
+        try:
+            with open(os.path.join(exp_dir, "tuner_state.json")) as f:
+                state = json.load(f)
+            return sum(1 for t in state["trials"]
+                       if t["status"] == "TERMINATED")
+        except Exception:
+            return 0
+
+    while n_done() < 2 and time.time() < deadline:
+        time.sleep(0.1)
+    tuner._abort.set()
+    th.join(timeout=60)
+    assert not errors, errors
+    done_before = n_done()
+    assert 2 <= done_before < 8
+
+    # Clear the run markers for finished trials: restore must NOT rerun them.
+    from ray_tpu.core.context import ctx
+
+    for k in ctx.client.kv_keys("ran:"):
+        ctx.client.kv_del(k)
+
+    restored = tune.Tuner.restore(exp_dir, _slow_fn)
+    results = restored.fit()
+    assert len(results) == 8
+    assert all(r.status == "TERMINATED" for r in results)
+    rerun = ctx.client.kv_keys("ran:")
+    assert len(rerun) == 8 - done_before  # only unfinished trials ran
+    assert results.get_best_result("value", "max").metrics["value"] == 7
