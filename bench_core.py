@@ -247,14 +247,36 @@ def bench_cross_node(quick: bool):
         cluster.shutdown()
 
 
+def bench_rllib(quick: bool):
+    """PPO sample+update throughput (BASELINE north star: RLlib PPO
+    env-steps/s; reference harness rllib/benchmarks/ppo)."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .build())
+    try:
+        algo.train()  # compile + warmup
+        rates = [algo.train()["env_steps_per_sec"]
+                 for _ in range(3 if quick else 10)]
+        record("ppo_env_steps_per_sec",
+               float(np.median(rates)), "steps/s")
+    finally:
+        algo.stop()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--skip-multinode", action="store_true")
+    ap.add_argument("--rllib", action="store_true")
     args = ap.parse_args()
 
     ray_tpu.init(num_cpus=8)
     bench_single_node(args.quick)
+    if args.rllib:
+        bench_rllib(args.quick)
     ray_tpu.shutdown()
 
     if not args.skip_multinode:

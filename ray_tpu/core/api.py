@@ -175,28 +175,8 @@ def init(
         ctx.mode = "driver"
         ctx.session = ctx.client.session
         ctx.namespace = namespace
-        _start_free_flusher()
         atexit.register(shutdown)
         return ctx
-
-
-def _start_free_flusher():
-    """Periodic flush of the batched ObjectRef free queue: without it, a
-    driver that drops a few refs and goes quiet holds head-side records (and
-    lineage pins) until the 16-entry batch fills or shutdown."""
-    from .object_ref import _flush_free_queue
-
-    client = ctx.client  # this session's client: the thread dies with it
-
-    def loop():
-        while ctx.initialized and ctx.client is client:
-            time.sleep(0.5)
-            try:
-                _flush_free_queue(background=True)
-            except Exception:
-                pass
-
-    threading.Thread(target=loop, daemon=True, name="free-flusher").start()
 
 
 async def _add_local_node(head: Head, resources, cap, labels):

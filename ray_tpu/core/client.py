@@ -85,6 +85,28 @@ class Client:
         self._pull_lock = threading.Lock()
         self.rpc.on_push("pubsub", self._on_pubsub)
         self.rpc.on_push("object_free", self._on_object_free)
+        # Free-queue flusher: ObjectRef.__del__ only appends + signals (it
+        # may run from cyclic GC inside a client critical section, so it
+        # must never take client locks itself); this thread does the RPCs.
+        threading.Thread(
+            target=self._free_flush_loop, daemon=True, name="free-flusher"
+        ).start()
+
+    def _free_flush_loop(self):
+        from . import object_ref as oref
+        from .context import ctx
+
+        while not self.rpc.closed:
+            oref.flush_wanted.wait(timeout=0.5)
+            oref.flush_wanted.clear()
+            if self.rpc.closed:
+                return
+            if ctx.client is not None and ctx.client is not self:
+                return  # superseded by a newer session's client
+            try:
+                oref._flush_free_queue(background=True)
+            except Exception:
+                pass
 
     # -- stores ----------------------------------------------------------------
 

@@ -541,32 +541,16 @@ class Head:
         # Spawn off-loop: the zygote handshake (or a fallback interpreter
         # boot) must never block the control plane's event loop.
         def do_spawn():
-            with self._zygote_mutex:
-                try:
-                    if self._zygote is None or not self._zygote.alive():
-                        from .zygote import Zygote
+            from .zygote import spawn_with_fallback
 
-                        self._zygote = Zygote(env)
-                    # Fork from the zygote (pre-imported worker runtime, ~ms)
-                    # instead of booting a fresh interpreter (~0.5s).
-                    pid = self._zygote.spawn(
-                        {k: v for k, v in env.items()
-                         if k.startswith(("RT_", "JAX_", "PYTHON"))},
-                        log=log_path,
-                    )
+            with self._zygote_mutex:
+                self._zygote, pid, proc = spawn_with_fallback(
+                    self._zygote, env, log_path
+                )
+                if pid is not None:
                     self.worker_pids.append(pid)
-                    return
-                except Exception:
-                    pass  # fall back to a direct interpreter boot
-            logf = open(log_path, "wb")
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker_main"],
-                env=env,
-                stdout=logf,
-                stderr=subprocess.STDOUT,
-            )
-            logf.close()
-            self.worker_procs.append(proc)
+                else:
+                    self.worker_procs.append(proc)
 
         asyncio.get_running_loop().run_in_executor(None, do_spawn)
 

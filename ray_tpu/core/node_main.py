@@ -262,35 +262,19 @@ class NodeDaemon:
         self._spawn_exec.submit(self._spawn_worker_blocking)
 
     def _spawn_worker_blocking(self):
+        from .zygote import spawn_with_fallback
+
         env = self._worker_env()
         log_dir = os.path.join("/tmp/ray_tpu_logs", self.session)
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
-        # Fork from the pre-imported zygote (~ms) instead of booting a fresh
-        # interpreter (~0.5s); fall back to Popen if the zygote died.
-        try:
-            if self.zygote is None or not self.zygote.alive():
-                from .zygote import Zygote
-
-                self.zygote = Zygote(env)
-            pid = self.zygote.spawn(
-                {k: v for k, v in env.items()
-                 if k.startswith(("RT_", "JAX_", "PYTHON"))},
-                log=log_path,
-            )
-            self.worker_pids.add(pid)
-            return
-        except Exception:
-            pass
-        logf = open(log_path, "wb")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env,
-            stdout=logf,
-            stderr=subprocess.STDOUT,
+        self.zygote, pid, proc = spawn_with_fallback(
+            self.zygote, env, log_path
         )
-        logf.close()
-        self.worker_procs.append(proc)
+        if pid is not None:
+            self.worker_pids.add(pid)
+        else:
+            self.worker_procs.append(proc)
 
     def _on_kill_worker(self, body):
         """SIGKILL a wedged local worker on the head's behalf — a stopped

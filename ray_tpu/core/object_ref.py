@@ -14,9 +14,14 @@ from . import serialization
 from .context import ctx
 from .ids import ObjectID
 
-# Batched free queue: ObjectRef.__del__ must never block on RPC.
+# Batched free queue: ObjectRef.__del__ must never block on RPC — and must
+# never call into Client methods at all: __del__ can run from cyclic GC
+# inside a client critical section, so taking any client lock here can
+# self-deadlock.  __del__ only appends and signals; the client's flusher
+# thread does the actual work.
 _free_lock = threading.Lock()
 _free_queue: list = []
+flush_wanted = threading.Event()
 
 
 def _flush_free_queue(background: bool = False):
@@ -77,8 +82,10 @@ class ObjectRef:
             raw = self._id.binary()
             with _free_lock:
                 _free_queue.append(raw)
+            # Wake the client's flusher thread; large objects get a prompt
+            # flush (their segments should return to the warm pool fast).
             if len(_free_queue) >= 16 or raw in ctx.client.large_oids:
-                _flush_free_queue(background=True)
+                flush_wanted.set()
 
     def __reduce__(self):
         # Crossing a process boundary: the receiver holds a borrowed reference.

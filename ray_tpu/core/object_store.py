@@ -277,6 +277,11 @@ class ObjectStore:
                 self._used -= seg.size
                 if not seg.close():
                     pool = False  # our own mapping still has live views
+                if object_id in self._pinned:
+                    # An in-flight bulk transfer holds an fd (sendfile):
+                    # unlink keeps the inode alive for that fd, pooling
+                    # would let a new writer overwrite it mid-stream.
+                    pool = False
                 if pool:
                     self._cooling.append((time.monotonic(), seg))
                 else:

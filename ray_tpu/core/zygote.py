@@ -159,5 +159,35 @@ class Zygote:
             pass
 
 
+def spawn_with_fallback(zygote: Optional[Zygote], env: Dict[str, str],
+                        log_path: str):
+    """Spawn one worker: fork from the zygote (~ms; reviving it if dead) or
+    fall back to a fresh interpreter boot.  Returns (zygote, pid, proc) —
+    exactly one of pid/proc is set.  Shared by the head's local spawner and
+    the node daemon."""
+    import subprocess as sp
+
+    try:
+        if zygote is None or not zygote.alive():
+            zygote = Zygote(env)
+        pid = zygote.spawn(
+            {k: v for k, v in env.items()
+             if k.startswith(("RT_", "JAX_", "PYTHON"))},
+            log=log_path,
+        )
+        return zygote, pid, None
+    except Exception:
+        pass  # fall back to a direct interpreter boot
+    logf = open(log_path, "wb")
+    proc = sp.Popen(
+        [sys.executable, "-m", "ray_tpu.core.worker_main"],
+        env=env,
+        stdout=logf,
+        stderr=sp.STDOUT,
+    )
+    logf.close()
+    return zygote, None, proc
+
+
 if __name__ == "__main__":
     main()

@@ -1,0 +1,18 @@
+"""ray_tpu.rllib: reinforcement learning on the actor runtime.
+
+Role-equivalent to RLlib's new API stack (reference: rllib/ — EnvRunner
+actors sample vectorized envs, a Learner updates the policy, weights sync
+through the object store), TPU-first: the learner is pure JAX (jit, or pjit
+over a Mesh for multi-chip) and env runners are CPU actors.
+"""
+
+from .env import CartPoleEnv, VectorEnv, make_env, register_env
+from .env_runner import EnvRunner
+from .learner import PPOLearner, compute_gae, init_policy, policy_forward
+from .ppo import PPO, PPOConfig
+
+__all__ = [
+    "PPO", "PPOConfig", "PPOLearner", "EnvRunner",
+    "CartPoleEnv", "VectorEnv", "make_env", "register_env",
+    "compute_gae", "init_policy", "policy_forward",
+]

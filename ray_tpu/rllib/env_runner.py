@@ -1,0 +1,128 @@
+"""EnvRunner actor: vectorized rollout collection on CPU hosts.
+
+Role-equivalent to the reference's SingleAgentEnvRunner
+(reference: rllib/env/single_agent_env_runner.py:61 sample:131 — vectorized
+envs, forward_exploration on the local policy copy, episode bookkeeping).
+The runner holds a CPU copy of the policy; weights arrive via the object
+store each iteration (reference: env_runner_group.sync_weights).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from .env import VectorEnv
+
+
+@ray_tpu.remote
+class EnvRunner:
+    def __init__(self, env_spec, num_envs: int, seed: int = 0):
+        import os
+
+        # Runner policy inference is tiny; never let XLA grab host threads
+        # aggressively or claim a TPU.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.vec = VectorEnv(env_spec, num_envs, seed=seed)
+        self.obs = self.vec.reset()
+        self.seed = seed
+        self._forward = None
+        self._params = None
+        self._rng = np.random.default_rng(seed + 1)
+
+    def _policy(self):
+        if self._forward is None:
+            import jax
+
+            from .learner import policy_forward
+
+            self._forward = jax.jit(policy_forward)
+        return self._forward
+
+    def set_weights(self, weights) -> bool:
+        import jax.numpy as jnp
+
+        from .learner import PolicyParams
+
+        self._params = PolicyParams(*[jnp.asarray(w) for w in weights])
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect [T, N] rollout fragments with logp/value for PPO
+        (reference: sample:131 returns episode lists; here the batch format
+        is the tensorized equivalent)."""
+        assert self._params is not None, "set_weights before sample"
+        import jax
+
+        fwd = self._policy()
+        N = self.vec.num_envs
+        obs_buf = np.empty((num_steps, N, self.vec.observation_size),
+                           np.float32)
+        act_buf = np.empty((num_steps, N), np.int32)
+        logp_buf = np.empty((num_steps, N), np.float32)
+        val_buf = np.empty((num_steps, N), np.float32)
+        rew_buf = np.empty((num_steps, N), np.float32)
+        done_buf = np.empty((num_steps, N), np.bool_)
+        # V(s_{t+1}) per row with episode semantics (see compute_gae):
+        # default = next row's value (filled after the loop); terminal = 0;
+        # truncated = V(true pre-reset state).
+        boot_buf = np.zeros((num_steps, N), np.float32)
+        boot_override: dict = {}
+        obs = self.obs
+        for t in range(num_steps):
+            logits, value = fwd(self._params, obs)
+            logits = np.asarray(logits)
+            # Gumbel-max sampling with numpy rng (stays reproducible and
+            # avoids host<->device PRNG churn per step).
+            gumbel = -np.log(-np.log(
+                self._rng.random(logits.shape) + 1e-12) + 1e-12)
+            actions = np.argmax(logits + gumbel, axis=-1).astype(np.int32)
+            logp_all = logits - jax.nn.logsumexp(logits, axis=-1,
+                                                 keepdims=True)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = np.take_along_axis(
+                np.asarray(logp_all), actions[:, None], axis=1)[:, 0]
+            val_buf[t] = np.asarray(value)
+            obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = terms | truncs
+            for i, o in final_obs.items():
+                # Terminated: bootstrap 0.  Truncated: V(true next state).
+                boot_override[(t, i)] = None if terms[i] else o
+        self.obs = obs
+        _, last_value = fwd(self._params, obs)
+        last_value = np.asarray(last_value)
+        boot_buf[:-1] = val_buf[1:]
+        boot_buf[-1] = last_value
+        if boot_override:
+            keys = [(t, i) for (t, i), o in boot_override.items()
+                    if o is not None]
+            if keys:
+                finals = np.stack([boot_override[k] for k in keys])
+                _, v_final = fwd(self._params, finals)
+                v_final = np.asarray(v_final)
+                for (t, i), v in zip(keys, v_final):
+                    boot_buf[t, i] = v
+            for (t, i), o in boot_override.items():
+                if o is None:
+                    boot_buf[t, i] = 0.0
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp_old": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "bootstrap_values": boot_buf,
+            "episode_returns": np.array(self.vec.drain_completed(),
+                                        np.float64),
+        }
+
+    def env_info(self) -> Dict[str, int]:
+        return {
+            "observation_size": self.vec.observation_size,
+            "num_actions": self.vec.num_actions,
+        }

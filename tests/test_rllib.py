@@ -1,0 +1,93 @@
+"""RLlib-equivalent tests: PPO learning on CartPole (the reference's
+canonical tuned example — rllib/tuned_examples/ppo/cartpole_ppo.py asserts
+reward thresholds), GAE math, and the pjit-sharded learner path.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import CartPoleEnv, PPOConfig, compute_gae
+from ray_tpu.rllib.learner import PPOLearner
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_cartpole_env_dynamics():
+    env = CartPoleEnv(seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    for _ in range(600):
+        obs, r, term, trunc = env.step(1)  # constant push falls over fast
+        total += r
+        if term or trunc:
+            break
+    assert term and total < 100  # one-sided policy fails quickly
+
+
+def test_compute_gae_terminal_vs_truncated():
+    rewards = np.ones((3, 1), np.float32)
+    values = np.zeros((3, 1), np.float32)
+    # Terminated at t=2: bootstrap 0.
+    boot = np.array([[0.0], [0.0], [0.0]], np.float32)
+    dones = np.array([[False], [False], [True]])
+    adv_term, _ = compute_gae(rewards, values, boot, dones, 1.0, 1.0)
+    # Truncated at t=2 with V(true next)=10: bootstrap rides through.
+    boot_trunc = np.array([[0.0], [0.0], [10.0]], np.float32)
+    adv_trunc, _ = compute_gae(rewards, values, boot_trunc, dones, 1.0, 1.0)
+    assert adv_trunc[2, 0] == adv_term[2, 0] + 10.0
+
+
+def test_learner_update_with_mesh():
+    """The sharded-update path: batch split over dp/fsdp, params replicated
+    (the compiled analog of DDP allreduce)."""
+    from ray_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=-1, tp=1, sp=1))
+    learner = PPOLearner(4, 2, mesh=mesh, seed=0)
+    n = 64
+    batch = {
+        "obs": np.random.default_rng(0).normal(size=(n, 4)).astype(np.float32),
+        "actions": np.zeros(n, np.int32),
+        "logp_old": np.full(n, -0.7, np.float32),
+        "advantages": np.random.default_rng(1).normal(size=n).astype(np.float32),
+        "returns": np.ones(n, np.float32),
+    }
+    metrics = learner.update_from_batch(batch, num_epochs=2,
+                                        minibatch_size=32)
+    assert np.isfinite(metrics["total_loss"])
+
+
+def test_ppo_cartpole_reaches_450(rt):
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=128)
+        .training(lr=3e-4, num_epochs=10, minibatch_size=256)
+        .build()
+    )
+    best = 0.0
+    sps = []
+    result = {}
+    try:
+        for _ in range(110):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            sps.append(result["env_steps_per_sec"])
+            if best >= 450:
+                break
+    finally:
+        algo.stop()
+    print(f"\nPPO CartPole: best return {best:.1f} after "
+          f"{result.get('num_env_steps_sampled_lifetime', 0)} env steps, "
+          f"median {np.median(sps):.0f} env-steps/s")
+    assert best >= 450, f"PPO failed to reach 450 (best {best})"
