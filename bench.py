@@ -91,22 +91,42 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        cfg = LlamaConfig.b1(remat=True, dtype=jnp.bfloat16, max_seq=2048)
-        plan = [(8, 2048, 10), (4, 2048, 10), (2, 2048, 10), (1, 1024, 10)]
+        base = LlamaConfig.b1(remat=True, dtype=jnp.bfloat16, max_seq=2048)
+        # (batch, seq, steps, remat_policy): xla_cse (XLA-chosen activation
+        # keeping) wins when it fits; full remat is the low-memory fallback.
+        # All viable configs run and the best MFU is reported.
+        plan = [
+            (4, 2048, 10, "xla_cse"),
+            (8, 2048, 10, "xla_cse"),
+            (8, 2048, 10, "full"),
+            (2, 2048, 10, "xla_cse"),
+            (1, 1024, 10, "full"),
+        ]
     else:
-        cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
-        plan = [(2, 128, 3)]
+        base = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+        plan = [(2, 128, 3, "full")]
+
+    import dataclasses
 
     result = None
-    for batch, seq, steps in plan:
+    for batch, seq, steps, policy in plan:
+        cfg = dataclasses.replace(base, remat_policy=policy)
         try:
-            result = _run(batch, seq, steps, cfg)
-            result["batch"] = batch
-            result["seq"] = seq
-            break
-        except Exception as e:  # OOM etc: retry smaller
-            print(f"# bench config ({batch}x{seq}) failed: {e}",
+            r = _run(batch, seq, steps, cfg)
+            r["batch"] = batch
+            r["seq"] = seq
+            r["remat_policy"] = policy
+            if result is None or r["mfu"] > result["mfu"]:
+                result = r
+            if not on_tpu:
+                break
+        except Exception as e:  # OOM etc: try the next config
+            msg = (str(e).splitlines() or [repr(e)])[0][:160]
+            print(f"# bench config ({batch}x{seq},{policy}) failed: {msg}",
                   file=sys.stderr)
+        if (result is not None and result["mfu"] > 0.60
+                and result["batch"] >= 8):
+            break  # good enough; don't burn bench time on small fallbacks
     if result is None:
         print(json.dumps({
             "metric": "llama_train_mfu", "value": 0.0, "unit": "%MFU",
@@ -127,6 +147,7 @@ def main():
         "n_params": result["n_params"],
         "batch": result["batch"],
         "seq": result["seq"],
+        "remat_policy": result.get("remat_policy", "full"),
     }))
     return 0
 

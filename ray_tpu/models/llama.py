@@ -45,6 +45,13 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # remat: rematerialize each block in backward (HBM <-> FLOPs trade)
     remat: bool = True
+    # Remat policy: "full" recomputes everything (lowest memory);
+    # "save_attn" asks the policy to keep flash-attention residuals
+    # (q/k/v/out/lse, tagged "flash_res"); "xla_cse" disables the CSE
+    # barrier so XLA itself chooses which activations to keep — the highest
+    # MFU when it fits in HBM (bench.py tries it first, falling back to
+    # "full").  Note: custom_vjp residual saving is best-effort — measure.
+    remat_policy: str = "full"
     # sp_axis set -> use ring attention over that mesh axis inside shard_map
     sp_ring: bool = False
 
@@ -206,20 +213,50 @@ def llama_apply(
     lora_params: Optional[Params] = None,
 ) -> jax.Array:
     """Returns logits [B, S, vocab]."""
+    x = llama_hidden(config, params, tokens, lora_params)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def llama_hidden(
+    config: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,
+    lora_params: Optional[Params] = None,
+) -> jax.Array:
+    """Final-norm hidden states [B, S, d] (logits = hidden @ lm_head)."""
     x = params["embed"][tokens].astype(config.dtype)
     cos, sin = rope_frequencies(
         config.head_dim, config.max_seq, config.rope_theta
     )
     block = _block
     if config.remat:
-        block = jax.checkpoint(
-            _block, static_argnums=(0,), prevent_cse=False
-        )
+        # prevent_cse must stay True (the default): under plain jit, CSE
+        # merges the backward's recomputation with the forward compute,
+        # which silently keeps every layer's activations live — remat in
+        # name only (observed: 19 simultaneous [8,2048,5632] mlp temps).
+        if config.remat_policy == "xla_cse":
+            # prevent_cse=False lets XLA CSE forward compute with backward
+            # recomputation — effectively XLA chooses which activations to
+            # keep.  Highest MFU when it fits; the "full" policy is the
+            # low-memory fallback.
+            block = jax.checkpoint(
+                _block, static_argnums=(0,), prevent_cse=False
+            )
+        else:
+            policy = None
+            if config.remat_policy == "save_attn":
+                from jax.ad_checkpoint import checkpoint_policies
+
+                policy = checkpoint_policies.save_only_these_names(
+                    "flash_res"
+                )
+            block = jax.checkpoint(
+                _block, static_argnums=(0,), policy=policy
+            )
     for i, layer in enumerate(params["layers"]):
         ll = lora_params["layers"][i] if lora_params is not None else None
         x = block(config, x, layer, cos, sin, ll)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return rms_norm(x, params["final_norm"], config.norm_eps)
 
 
 def llama_loss(
@@ -230,13 +267,41 @@ def llama_loss(
     lora_params: Optional[Params] = None,
     ignore_index: int = -100,
 ) -> jax.Array:
-    logits = llama_apply(config, params, tokens, lora_params)
-    mask = targets != ignore_index
-    tgt = jnp.where(mask, targets, 0)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * mask
-    return nll.sum() / jnp.maximum(mask.sum(), 1)
+    """Causal-LM cross entropy with a seq-chunked vocab projection: the
+    full fp32 logits tensor ([B, S, vocab] — 2 GiB at 8x2048x32k, plus its
+    gradient) never materializes; each chunk's logits are rematerialized in
+    the backward pass (jax.checkpoint over the chunk loss)."""
+    hidden = llama_hidden(config, params, tokens, lora_params)
+    B, S, d = hidden.shape
+    w = params["lm_head"]
+
+    def chunk_nll(h_c, tgt_c):
+        logits = (h_c @ w).astype(jnp.float32)
+        mask = tgt_c != ignore_index
+        tgt = jnp.where(mask, tgt_c, 0)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * mask
+        return nll.sum(), mask.sum()
+
+    chunk = 256
+    if S % chunk != 0:
+        total, count = chunk_nll(hidden, targets)
+        return total / jnp.maximum(count, 1)
+    n_chunks = S // chunk
+    h = hidden.reshape(B, n_chunks, chunk, d).transpose(1, 0, 2, 3)
+    t = targets.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    def scan_body(carry, xs):
+        total, count = carry
+        nll, cnt = jax.checkpoint(chunk_nll)(xs[0], xs[1])
+        return (total + nll, count + cnt), None
+
+    (total, count), _ = jax.lax.scan(
+        scan_body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (h, t),
+    )
+    return total / jnp.maximum(count, 1)
 
 
 # --------------------------------------------------------------------- LoRA

@@ -24,9 +24,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_Q = 512
 LSE_LANES = 128  # trailing pad so lse blocks meet TPU tiling
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -317,17 +317,29 @@ def _flash(q, k, v, sm_scale, causal, q_offset, block_q, block_k, interpret):
 
 def _flash_vjp_fwd(q, k, v, sm_scale, causal, q_offset, block_q, block_k,
                    interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _flash_fwd(
         q, k, v, sm_scale, causal, q_offset, block_q, block_k, interpret
     )
-    return out, (q, k, v, out, lse)
+    # Residuals are stored with the lse squeezed to [B, H, S] (the padded
+    # lane dim only exists for TPU tiling) and tagged so remat policies can
+    # choose to SAVE them — skipping the full attention-forward recompute
+    # in the backward pass (see llama.py remat_policy="save_attn").
+    res = checkpoint_name((q, k, v, out, lse[..., 0]), "flash_res")
+    return out, res
 
 
 def _flash_vjp_bwd(sm_scale, causal, q_offset, block_q, block_k, interpret,
                    res, g):
+    q, k, v, out, lse_slim = res
+    lse = jnp.broadcast_to(
+        lse_slim[..., None], lse_slim.shape + (LSE_LANES,)
+    )
     return _flash_bwd(
-        res, g, sm_scale=sm_scale, causal=causal, q_offset=q_offset,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        (q, k, v, out, lse), g, sm_scale=sm_scale, causal=causal,
+        q_offset=q_offset, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
 
 
@@ -352,14 +364,19 @@ def flash_attention(
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     scale = sm_scale if sm_scale is not None else D ** -0.5
-    bq, bk = min(block_q, Sq), min(block_k, Sk)
-    use_pallas = force_pallas or _on_tpu()
-    # The kernels assume block-divisible sequence lengths; odd lengths take
+    # The kernels need block-divisible sequence lengths: shrink by powers of
+    # two until the block divides (768 -> 256, etc.); truly odd lengths take
     # the XLA reference path rather than reading/writing garbage tails.
+    bq, bk = min(block_q, Sq), min(block_k, Sk)
+    while bq > 16 and Sq % bq:
+        bq //= 2
+    while bk > 16 and Sk % bk:
+        bk //= 2
+    use_pallas = force_pallas or _on_tpu()
     if Sq % bq or Sk % bk:
         use_pallas = False
     if not use_pallas:
         return mha_reference(
             q, k, v, causal=causal, sm_scale=scale, q_offset=q_offset
         )
-    return _flash(q, k, v, scale, causal, q_offset, block_q, block_k, interpret)
+    return _flash(q, k, v, scale, causal, q_offset, bq, bk, interpret)

@@ -12,11 +12,15 @@ collective-permute).
 Call inside shard_map with sequence dim sharded over `axis_name`; falls back
 to plain flash attention when the axis has size 1.
 
-Per-chunk math uses the differentiable blockwise form (checkpointed) rather
-than the Pallas kernel: the ring combiner needs d(lse) contributions, which
-the flash kernel's VJP does not expose.  Fusing ring+flash into one joint
-custom VJP is the known next optimization (striped/blockwise-parallel
-attention).
+On TPU the per-chunk math runs the Pallas flash kernels under one JOINT
+custom VJP over the whole ring: the forward combines per-chunk (out, lse)
+with the online-softmax rule; the backward re-rotates K/V and feeds the
+flash backward kernels the GLOBAL lse/delta (the standard flash
+decomposition is exact across chunks), with dK/dV accumulators riding the
+ring home to their owner shard.  Causal masking across chunks uses the
+kernels' q_offset (a prefetch scalar, so it may be rank-dependent): future
+chunks mask fully, past chunks fully visible, the diagonal chunk is causal.
+Off-TPU the blockwise jnp form remains as the differentiable fallback.
 """
 
 from __future__ import annotations
@@ -28,7 +32,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .attention import NEG_INF, flash_attention
+from .attention import (
+    LSE_LANES,
+    NEG_INF,
+    _flash_bwd,
+    _flash_fwd,
+    _on_tpu,
+    flash_attention,
+)
 
 
 def _chunk_attn(q, k, v, scale, mode):
@@ -80,6 +91,111 @@ def _chunk_attn(q, k, v, scale, mode):
     )
 
 
+# ------------------------------------------------- fused ring+flash (TPU)
+
+
+def _ring_blocks(S: int) -> tuple:
+    bq = min(256, S)
+    bk = min(256, S)
+    if S % bq or S % bk:
+        raise ValueError(f"ring kernel needs block-divisible S, got {S}")
+    return bq, bk
+
+
+def _ring_flash_fwd_impl(q, k, v, scale, axis_name, n, interpret):
+    rank = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    bq, bk = _ring_blocks(S)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc = jnp.zeros((B, H, S, D), jnp.float32)
+    m_run = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l_run = jnp.zeros((B, H, S), jnp.float32)
+    k_cur, v_cur = k, v
+    for s in range(n):  # unrolled: n is a small static mesh-axis size
+        src = (rank - s) % n
+        # Global offset of this shard's Q rows relative to the K chunk it
+        # currently holds: negative (future chunk) masks everything, >= S
+        # (past chunk) masks nothing, 0 is the causal diagonal.
+        offset = (rank - src) * S
+        out_c, lse_c = _flash_fwd(
+            q, k_cur, v_cur, scale, True, offset, bq, bk, interpret
+        )
+        lse_c = lse_c[..., 0]
+        m_new = jnp.maximum(m_run, lse_c)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(lse_c - m_new)
+        acc = acc * alpha[..., None] + out_c.astype(jnp.float32) * beta[..., None]
+        l_run = l_run * alpha + beta
+        m_run = m_new
+        if s < n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    out = (acc / jnp.maximum(l_run, 1e-30)[..., None]).astype(q.dtype)
+    lse_total = m_run + jnp.log(jnp.maximum(l_run, 1e-30))
+    return out, lse_total
+
+
+def _ring_flash_bwd_impl(q, k, v, out, lse_total, do, scale, axis_name, n,
+                         interpret):
+    rank = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    bq, bk = _ring_blocks(S)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    lse4 = jnp.broadcast_to(
+        lse_total[..., None], lse_total.shape + (LSE_LANES,)
+    )
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    for s in range(n):
+        src = (rank - s) % n
+        offset = (rank - src) * S
+        dq_c, dk_c, dv_c = _flash_bwd(
+            (q, k_cur, v_cur, out, lse4), do,
+            sm_scale=scale, causal=True, q_offset=offset,
+            block_q=bq, block_k=bk, interpret=interpret,
+        )
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_acc = dk_acc + dk_c.astype(jnp.float32)
+        dv_acc = dv_acc + dv_c.astype(jnp.float32)
+        # dK/dV accumulators travel WITH their K/V chunk; after n rotations
+        # every chunk's gradient is home.  K/V themselves aren't read after
+        # the last step, so only the accumulators take the final hop.
+        if s < n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, scale, axis_name, n, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, scale, axis_name, n, interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, scale, axis_name, n, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _ring_flash_fwd_impl(q, k, v, scale, axis_name, n, interpret)
+    # Tagged like the single-shard flash residuals so remat policies can
+    # keep them (skipping the whole ring-forward recompute in backward).
+    res = checkpoint_name((q, k, v, out, lse), "flash_res")
+    return out, res
+
+
+def _ring_flash_vjp_bwd(scale, axis_name, n, interpret, res, g):
+    q, k, v, out, lse = res
+    return _ring_flash_bwd_impl(
+        q, k, v, out, lse, g, scale, axis_name, n, interpret
+    )
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -88,6 +204,8 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    force_kernel: bool = False,
+    interpret: bool = False,
 ) -> jax.Array:
     """[B, H, S_local, D] in, same out.  Must run inside shard_map when the
     sp axis is >1."""
@@ -104,6 +222,13 @@ def ring_attention(
         kg = lax.all_gather(k, axis_name, axis=2, tiled=True)
         vg = lax.all_gather(v, axis_name, axis=2, tiled=True)
         return flash_attention(q, kg, vg, causal=False, sm_scale=scale)
+
+    S = q.shape[2]
+    use_kernel = (force_kernel or _on_tpu()) and S % min(256, S) == 0
+    if use_kernel:
+        # Fused ring+flash: Pallas kernels inside one joint custom VJP.
+        return _ring_flash(q, k, v, scale, axis_name, n,
+                           interpret or not _on_tpu())
 
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
