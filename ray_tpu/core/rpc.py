@@ -165,6 +165,11 @@ class RpcServer:
             await conn._send([RESP, seq, method, result])
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             tb = traceback.format_exc()
+            import os as _os
+            if _os.environ.get("RT_DEBUG_RPC_ERR"):
+                import sys as _sys
+                print(f"RPC ERR in {method}: {e}\n{tb}", file=_sys.stderr,
+                      flush=True)
             try:
                 await conn._send([ERR, seq, method, f"{e}\n{tb}"])
             except Exception:

@@ -40,7 +40,11 @@ def _to_host(obj: Any) -> Any:
     import sys
 
     jax = sys.modules.get("jax")  # never import jax just to type-check
-    if jax is not None and isinstance(obj, jax.Array):
+    # getattr guard: another thread may be mid-`import jax` (partially
+    # initialized module without .Array) — such an object can't be a jax
+    # array anyway.
+    array_t = getattr(jax, "Array", None) if jax is not None else None
+    if array_t is not None and isinstance(obj, array_t):
         import numpy as np
 
         return np.asarray(obj)

@@ -38,6 +38,8 @@ from .context import ctx
 from .ids import ActorID, ObjectID, TaskID
 from .object_ref import ObjectRef, _TopLevelRef
 
+_DEBUG_PUSH = bool(os.environ.get("RT_DEBUG_PUSH"))
+
 
 class Worker:
     def __init__(self):
@@ -70,7 +72,14 @@ class Worker:
         self.cancelled: set = set()
         self._shutdown = threading.Event()
 
-        self.client.rpc.on_push("execute_task", self.task_queue.put)
+        def _on_exec(spec):
+            if _DEBUG_PUSH:
+                print(f"PUSH execute_task {spec.get('name')} "
+                      f"{spec['task_id'].hex()[:8]}", file=sys.stderr,
+                      flush=True)
+            self.task_queue.put(spec)
+
+        self.client.rpc.on_push("execute_task", _on_exec)
         self.client.rpc.on_push("cancel", self._on_cancel)
         self.client.rpc.on_push("shutdown", lambda b: self._shutdown.set())
         self.client.rpc.on_push("exit", lambda b: os._exit(1))
@@ -153,13 +162,24 @@ class Worker:
             # waiting a round trip (reference: PushTask replies carry results
             # asynchronously).  Connection loss exits via on_connection_lost.
             self.client.call_bg("task_done", body)
+            if _DEBUG_PUSH:
+                print(f"DONE-SENT {spec.get('name')} "
+                      f"{spec['task_id'].hex()[:8]}", file=sys.stderr,
+                      flush=True)
         except Exception:
+            if _DEBUG_PUSH:
+                print(f"DONE-FAIL {spec.get('name')}: "
+                      f"{traceback.format_exc()}", file=sys.stderr,
+                      flush=True)
             os._exit(1)
 
     # -------------------------------------------------------------- execution
 
     def _execute(self, spec):
         task_id = spec["task_id"]
+        if _DEBUG_PUSH:
+            print(f"EXEC start {spec.get('name')} {task_id.hex()[:8]}",
+                  file=sys.stderr, flush=True)
         ctx.current_task_id = TaskID(task_id)
         self.running_threads[task_id] = threading.get_ident()
         saved_env: Dict[str, Optional[str]] = {}
@@ -197,6 +217,9 @@ class Worker:
             if inspect.iscoroutinefunction(
                 fn.__func__ if inspect.ismethod(fn) else fn
             ):
+                if os.environ.get("RT_DEBUG_PUSH"):
+                    print(f"ASYNC-DISPATCH {spec.get('name')} {spec['task_id'].hex()[:8]}",
+                          file=sys.stderr, flush=True)
                 self._execute_async(spec, fn, args, kwargs)
                 return
 
@@ -217,7 +240,20 @@ class Worker:
 
             self._finish_ok(spec, result)
         except BaseException as e:  # noqa: BLE001 — all errors cross the wire
-            self._finish_err(spec, e)
+            if _DEBUG_PUSH:
+                print(f"EXEC-ERR {spec.get('name')} {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+            try:
+                self._finish_err(spec, e)
+            except BaseException:  # noqa: BLE001 — a lost task_done hangs
+                # the caller forever; report with a plain-string error even
+                # when serializing the real one failed.
+                self._report_done(
+                    spec, error=serialization.pack(
+                        exceptions.TaskError(RuntimeError(repr(e)), "")
+                    ),
+                    error_repr=repr(e),
+                )
         finally:
             # Actor processes keep their runtime_env; pooled task workers
             # restore so env vars don't leak into unrelated tasks.
@@ -229,6 +265,9 @@ class Worker:
                         os.environ[k] = old
             self.running_threads.pop(task_id, None)
             ctx.current_task_id = None
+            if _DEBUG_PUSH:
+                print(f"EXEC end {spec.get('name')} {task_id.hex()[:8]}",
+                      file=sys.stderr, flush=True)
 
     def _finish_ok(self, spec, result):
         num_returns = spec.get("num_returns", 1)

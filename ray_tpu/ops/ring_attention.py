@@ -224,7 +224,9 @@ def ring_attention(
         return flash_attention(q, kg, vg, causal=False, sm_scale=scale)
 
     S = q.shape[2]
-    use_kernel = (force_kernel or _on_tpu()) and S % min(256, S) == 0
+    # The fused kernels need TPU-tileable per-shard lengths (multiples of
+    # the 256 block); anything else takes the blockwise jnp path below.
+    use_kernel = (force_kernel or _on_tpu()) and S >= 256 and S % 256 == 0
     if use_kernel:
         # Fused ring+flash: Pallas kernels inside one joint custom VJP.
         return _ring_flash(q, k, v, scale, axis_name, n,

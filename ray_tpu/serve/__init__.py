@@ -1,0 +1,28 @@
+"""ray_tpu.serve: model serving on the actor runtime.
+
+Role-equivalent to Ray Serve (reference: python/ray/serve — controller
+reconcile loop, replica actors, power-of-two routing, batching, HTTP
+ingress, request-based autoscaling), TPU-first: replicas reserve chips via
+ray_actor_options and batch requests into jit-compiled inference calls.
+"""
+
+from .api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http,
+    status,
+    stop_http,
+)
+from .batching import batch
+from .handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "delete", "status",
+    "shutdown", "get_deployment_handle", "DeploymentHandle",
+    "DeploymentResponse", "batch", "start_http", "stop_http",
+]

@@ -1,0 +1,219 @@
+"""Public Serve API: @deployment, run, handles, HTTP ingress.
+
+Role-equivalent to the reference's serve.api
+(reference: serve/api.py:510 serve.run -> controller deploy; deployment
+decorator serve/deployment.py; stdlib-http ingress plays the HTTPProxy role,
+reference: serve/_private/proxy.py:766).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ..train.worker_group import _dumps_by_value
+from .controller import CONTROLLER_NAME, get_or_create_controller
+from .handle import DeploymentHandle
+
+
+class Application:
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, cls_or_fn: Callable, name: str,
+                 num_replicas: int = 1,
+                 max_concurrent_queries: int = 8,
+                 ray_actor_options: Optional[dict] = None,
+                 autoscaling_config: Optional[dict] = None):
+        self._callable = cls_or_fn
+        self.name = name
+        self.num_replicas = num_replicas
+        self.max_concurrent_queries = max_concurrent_queries
+        self.ray_actor_options = ray_actor_options or {}
+        self.autoscaling_config = autoscaling_config
+
+    def options(self, **overrides) -> "Deployment":
+        fields = {
+            "name": self.name,
+            "num_replicas": self.num_replicas,
+            "max_concurrent_queries": self.max_concurrent_queries,
+            "ray_actor_options": self.ray_actor_options,
+            "autoscaling_config": self.autoscaling_config,
+        }
+        fields.update(overrides)
+        return Deployment(self._callable, **fields)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def to_spec(self, app: Application) -> dict:
+        res = {}
+        opts = self.ray_actor_options
+        if opts.get("num_cpus") is not None:
+            res["CPU"] = opts["num_cpus"]
+        if opts.get("num_tpus"):
+            res["TPU"] = opts["num_tpus"]
+        spec = {
+            "cls_blob": _dumps_by_value(self._callable),
+            "init_args_blob": cloudpickle.dumps(
+                (app.init_args, app.init_kwargs)
+            ),
+            "num_replicas": self.num_replicas,
+            "max_concurrent": self.max_concurrent_queries,
+            "resources": res,
+        }
+        if self.autoscaling_config:
+            ac = dict(self.autoscaling_config)
+            ac.setdefault("min_replicas", 1)
+            ac.setdefault("max_replicas", max(ac["min_replicas"], 4))
+            spec["autoscaling"] = ac
+        return spec
+
+
+def deployment(_cls=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_concurrent_queries: int = 8,
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict] = None):
+    """@serve.deployment decorator (reference: serve/deployment.py)."""
+
+    def deco(cls_or_fn):
+        return Deployment(
+            cls_or_fn,
+            name or getattr(cls_or_fn, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            ray_actor_options=ray_actor_options,
+            autoscaling_config=autoscaling_config,
+        )
+
+    if _cls is not None:
+        return deco(_cls)
+    return deco
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        wait_ready: bool = True, timeout: float = 120.0) -> DeploymentHandle:
+    """Deploy an application and return its handle (reference:
+    serve/api.py:510 serve.run)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    dep = app.deployment
+    dep_name = name or dep.name
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.deploy.remote(dep_name, dep.to_spec(app)),
+                timeout=timeout)
+    if wait_ready:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if ray_tpu.get(controller.ready.remote(dep_name), timeout=30):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(f"deployment {dep_name!r} not ready")
+    return DeploymentHandle(dep_name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def delete(name: str):
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete.remote(name), timeout=30)
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+
+
+# ------------------------------------------------------------- HTTP ingress
+
+
+class _HttpProxy:
+    """Minimal stdlib HTTP ingress: POST /<deployment> with a JSON body
+    calls the deployment and returns the JSON result (the HTTPProxy role,
+    reference: serve/_private/proxy.py:766 routed by LongestPrefixRouter)."""
+
+    def __init__(self, host: str, port: int):
+        import http.server
+
+        handles: Dict[str, DeploymentHandle] = {}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — stdlib naming
+                name = self.path.strip("/").split("/")[0]
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                    payload = json.loads(body) if body else None
+                    h = handles.get(name)
+                    if h is None:
+                        h = handles[name] = DeploymentHandle(name)
+                    if isinstance(payload, dict):
+                        resp = h.remote(**payload).result()
+                    elif payload is None:
+                        resp = h.remote().result()
+                    else:
+                        resp = h.remote(payload).result()
+                    out = json.dumps(resp).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001 — surfaces as a 500
+                    out = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True,
+                         name="serve-http").start()
+
+    def close(self):
+        self.server.shutdown()
+
+
+_proxy: Optional[_HttpProxy] = None
+
+
+def start_http(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the HTTP ingress; returns the bound port."""
+    global _proxy
+    if _proxy is None:
+        _proxy = _HttpProxy(host, port)
+    return _proxy.port
+
+
+def stop_http():
+    global _proxy
+    if _proxy is not None:
+        _proxy.close()
+        _proxy = None

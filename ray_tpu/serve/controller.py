@@ -1,0 +1,246 @@
+"""ServeController: the deployment reconcile loop.
+
+Role-equivalent to the reference's ServeController
+(reference: serve/_private/controller.py:86 run_control_loop:372 +
+deployment_state.py:2312 DeploymentStateManager): holds target state per
+deployment, reconciles actual replica actors toward it (create on deploy /
+scale-up, drain on scale-down, replace on death), and serves routing tables
+to handles.  Request-based autoscaling compares reported queue pressure to
+target (reference: autoscaling_state.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@ray_tpu.remote(max_concurrency=8)
+class ServeController:
+    def __init__(self):
+        # name -> target spec dict
+        self.targets: Dict[str, dict] = {}
+        # name -> list of {"handle": ActorHandle, "id": int}
+        self.replicas: Dict[str, List[dict]] = {}
+        self._next_replica_id = 0
+        self._lock = threading.Lock()
+        self._version = 0
+        self._shutdown = False
+        threading.Thread(target=self._control_loop, daemon=True,
+                         name="serve-reconcile").start()
+
+    # -- API -----------------------------------------------------------------
+
+    def deploy(self, name: str, spec: dict) -> bool:
+        """Set a deployment's target (create or update).  spec: cls_blob,
+        init_args_blob, num_replicas, max_concurrent, resources,
+        autoscaling (optional {min_replicas, max_replicas,
+        target_ongoing_requests})."""
+        with self._lock:
+            old = self.targets.get(name)
+            spec = dict(spec)
+            spec["version"] = (old["version"] + 1) if old else 1
+            self.targets[name] = spec
+            self._version += 1
+        return True
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            self.targets.pop(name, None)
+            self._version += 1
+        return True
+
+    def routing_table(self) -> dict:
+        """Replica actor handles per deployment (handles reconstruct
+        actor refs on the receiving side)."""
+        with self._lock:
+            return {
+                "version": self._version,
+                "deployments": {
+                    name: [r["handle"] for r in reps]
+                    for name, reps in self.replicas.items()
+                },
+            }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": self._target_replicas(name),
+                    "running_replicas": len(self.replicas.get(name, [])),
+                    "version": spec["version"],
+                }
+                for name, spec in self.targets.items()
+            }
+
+    def ready(self, name: str) -> bool:
+        with self._lock:
+            spec = self.targets.get(name)
+            if spec is None:
+                return False
+            # Only CURRENT-version replicas count: a redeploy isn't ready
+            # while old-code replicas still serve.
+            current = [
+                r for r in self.replicas.get(name, [])
+                if r["version"] == spec["version"]
+            ]
+            return len(current) >= max(1, self._target_replicas(name))
+
+    def shutdown(self) -> bool:
+        with self._lock:
+            self._shutdown = True
+            self.targets.clear()
+        return True
+
+    # -- reconcile -----------------------------------------------------------
+
+    def _target_replicas(self, name: str) -> int:
+        spec = self.targets.get(name)
+        if spec is None:
+            return 0
+        auto = spec.get("autoscaling")
+        if auto:
+            return spec.get("_autoscaled", auto["min_replicas"])
+        return spec.get("num_replicas", 1)
+
+    def _control_loop(self):
+        from .replica import ServeReplica
+
+        while True:
+            time.sleep(0.2)
+            with self._lock:
+                if self._shutdown and not any(self.replicas.values()):
+                    break
+                targets = dict(self.targets)
+            # Drop deployments no longer targeted.
+            for name in list(self.replicas):
+                if name not in targets:
+                    with self._lock:
+                        dropped = self.replicas.pop(name, [])
+                        self._version += 1
+                    for r in dropped:
+                        self._stop_replica(r)
+            for name, spec in targets.items():
+                with self._lock:
+                    reps = list(self.replicas.get(name, ()))
+                # Replace dead replicas and version-mismatched ones
+                # (rolling update: new code/config -> new actors).  Health
+                # probes go out in parallel; stragglers past the deadline
+                # count as dead (a single hung replica must not stall the
+                # loop for every deployment).
+                changed = False
+                alive_flags = self._alive_many(reps)
+                live = []
+                for r, ok in zip(reps, alive_flags):
+                    if r["version"] != spec["version"] or not ok:
+                        self._stop_replica(r)
+                        changed = True
+                    else:
+                        live.append(r)
+                reps = live
+                self._autoscale(name, spec, reps)
+                want = self._target_replicas(name)
+                while len(reps) < want:
+                    try:
+                        reps.append(self._start_replica(name, spec))
+                        changed = True
+                    except Exception:
+                        break
+                while len(reps) > want:
+                    self._stop_replica(reps.pop())
+                    changed = True
+                with self._lock:
+                    if name in self.targets:
+                        self.replicas[name] = reps
+                    if changed:
+                        self._version += 1
+
+    def _alive_many(self, reps: List[dict]) -> List[bool]:
+        if not reps:
+            return []
+        try:
+            refs = [r["handle"].ping.remote() for r in reps]
+        except Exception:
+            return [False] * len(reps)
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=5)
+        ready_set = set(ready)
+        out = []
+        for ref in refs:
+            if ref not in ready_set:
+                out.append(False)  # straggler past the deadline
+                continue
+            try:
+                out.append(ray_tpu.get(ref, timeout=1) == "ok")
+            except Exception:
+                out.append(False)  # sealed with ActorDiedError etc.
+        return out
+
+    def _start_replica(self, name: str, spec: dict) -> dict:
+        from .replica import ServeReplica
+
+        self._next_replica_id += 1
+        opts: Dict[str, Any] = {
+            "max_concurrency": spec.get("max_concurrent", 8),
+            "name": f"SERVE_REPLICA:{name}#{self._next_replica_id}",
+        }
+        res = spec.get("resources") or {}
+        if res.get("CPU") is not None:
+            opts["num_cpus"] = res["CPU"]
+        if res.get("TPU"):
+            opts["num_tpus"] = res["TPU"]
+        handle = ServeReplica.options(**opts).remote(
+            name, spec["cls_blob"], spec["init_args_blob"]
+        )
+        ray_tpu.get(handle.ping.remote(), timeout=120)  # wait ready
+        return {"handle": handle, "id": self._next_replica_id,
+                "version": spec["version"]}
+
+    def _stop_replica(self, r: dict):
+        try:
+            ray_tpu.kill(r["handle"])
+        except Exception:
+            pass
+
+    def _autoscale(self, name: str, spec: dict, reps: List[dict]):
+        auto = spec.get("autoscaling")
+        if not auto:
+            return
+        if not reps:
+            spec.setdefault("_autoscaled", auto["min_replicas"])
+            return
+        total_q = 0
+        for r in reps:
+            try:
+                total_q += ray_tpu.get(r["handle"].queue_len.remote(),
+                                       timeout=5)
+            except Exception:
+                pass
+        per = total_q / max(1, len(reps))
+        target = auto.get("target_ongoing_requests", 2)
+        cur = spec.get("_autoscaled", auto["min_replicas"])
+        if per > target and cur < auto["max_replicas"]:
+            cur += 1
+        elif per < target / 2 and cur > auto["min_replicas"]:
+            cur -= 1
+        spec["_autoscaled"] = cur
+        with self._lock:
+            if name in self.targets:
+                self.targets[name]["_autoscaled"] = cur
+
+
+def get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        try:
+            return ServeController.options(
+                name=CONTROLLER_NAME, num_cpus=0
+            ).remote()
+        except Exception:
+            # Raced another creator: the name is taken now.
+            return ray_tpu.get_actor(CONTROLLER_NAME)

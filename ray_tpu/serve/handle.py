@@ -1,0 +1,163 @@
+"""DeploymentHandle: route requests to replicas.
+
+Role-equivalent to the reference's DeploymentHandle -> Router ->
+PowerOfTwoChoicesReplicaScheduler chain
+(reference: serve/handle.py:729 .remote, _private/router.py:560
+assign_request, replica_scheduler/pow_2_scheduler.py:51): two random
+replicas are compared by queue pressure and the less-loaded one gets the
+request.  The routing table refreshes from the controller when its version
+changes (the long-poll analog, reference: _private/long_poll.py).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference: serve/handle.py
+    DeploymentResponse).  A replica dying under the request (rolling
+    update, crash) re-routes it once the routing table refreshes
+    (reference: the router retries failed replicas)."""
+
+    def __init__(self, ref, done_cb=None, retry=None):
+        self._ref = ref
+        self._done_cb = done_cb
+        self._retry = retry
+
+    def result(self, timeout: float = 60.0):
+        from ..exceptions import ActorDiedError, WorkerCrashedError
+
+        try:
+            for attempt in range(3):
+                try:
+                    return ray_tpu.get(self._ref, timeout=timeout)
+                except (ActorDiedError, WorkerCrashedError):
+                    if self._retry is None or attempt == 2:
+                        raise
+                    time.sleep(0.2 * (attempt + 1))
+                    self._ref = self._retry()
+        finally:
+            if self._done_cb is not None:
+                self._done_cb()
+                self._done_cb = None
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.method = method
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._last_refresh = 0.0
+        self._local_load: Dict[int, int] = {}  # replica idx -> outstanding
+        self._lock = threading.Lock()
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, method_name)
+        return h
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._replicas and now - self._last_refresh < 1.0:
+                return
+        from .controller import get_or_create_controller
+
+        controller = get_or_create_controller()
+        table = ray_tpu.get(controller.routing_table.remote(), timeout=30)
+        with self._lock:
+            if table["version"] != self._version:
+                self._replicas = table["deployments"].get(
+                    self.deployment_name, []
+                )
+                self._version = table["version"]
+                self._local_load = {i: 0 for i in range(len(self._replicas))}
+            self._last_refresh = now
+
+    def _pick(self) -> int:
+        """Power-of-two-choices on the handle's local outstanding counts
+        (the client-side view of queue pressure)."""
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        i, j = random.sample(range(n), 2)
+        return i if self._local_load.get(i, 0) <= self._local_load.get(j, 0) \
+            else j
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        deadline = time.monotonic() + 30
+        while True:
+            self._refresh()
+            with self._lock:
+                if self._replicas:
+                    idx = self._pick()
+                    replica = self._replicas[idx]
+                    self._local_load[idx] = self._local_load.get(idx, 0) + 1
+                    break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no running "
+                    "replicas"
+                )
+            time.sleep(0.1)
+            self._refresh(force=True)
+
+        state = {"idx": idx}
+
+        def done():
+            with self._lock:
+                i = state["idx"]
+                if i in self._local_load:
+                    self._local_load[i] = max(0, self._local_load[i] - 1)
+
+        try:
+            ref = replica.handle_request.remote(
+                self.method, args, kwargs
+            )
+        except Exception:
+            done()
+            # Replica likely died: force-refresh and retry once.
+            self._refresh(force=True)
+            with self._lock:
+                if not self._replicas:
+                    raise
+                idx = self._pick()
+                replica = self._replicas[idx]
+                self._local_load[idx] = self._local_load.get(idx, 0) + 1
+            ref = replica.handle_request.remote(self.method, args, kwargs)
+
+        def retry():
+            self._refresh(force=True)
+            with self._lock:
+                if not self._replicas:
+                    raise RuntimeError(
+                        f"deployment {self.deployment_name!r} has no "
+                        "running replicas"
+                    )
+                i = self._pick()
+                rep = self._replicas[i]
+                # Transfer the outstanding count to the retry target so the
+                # p2c picker sees its real pressure; done() releases it.
+                old = state["idx"]
+                if old in self._local_load:
+                    self._local_load[old] = max(
+                        0, self._local_load[old] - 1
+                    )
+                self._local_load[i] = self._local_load.get(i, 0) + 1
+                state["idx"] = i
+            return rep.handle_request.remote(self.method, args, kwargs)
+
+        return DeploymentResponse(ref, done, retry)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.method))

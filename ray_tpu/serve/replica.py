@@ -1,0 +1,63 @@
+"""ServeReplica: hosts one copy of a deployment's user callable.
+
+Role-equivalent to the reference's ReplicaActor
+(reference: serve/_private/replica.py:231 — runs the user class, exposes a
+queue-length probe used by the power-of-two router).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+from typing import Any
+
+import cloudpickle
+
+import ray_tpu
+
+
+@ray_tpu.remote(max_concurrency=16)
+class ServeReplica:
+    def __init__(self, deployment_name: str, cls_blob: bytes,
+                 init_args_blob: bytes):
+        self.deployment_name = deployment_name
+        cls = cloudpickle.loads(cls_blob)
+        args, kwargs = cloudpickle.loads(init_args_blob)
+        self.user = cls(*args, **kwargs) if inspect.isclass(cls) else None
+        self.user_fn = None if self.user is not None else cls
+        self._ongoing = 0
+        self._count_lock = threading.Lock()
+
+    def ping(self) -> str:
+        return "ok"
+
+    def queue_len(self) -> int:
+        """Outstanding requests (reference: the router's queue-length probe,
+        pow_2_scheduler.py)."""
+        return self._ongoing
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+        with self._count_lock:
+            self._ongoing += 1
+        try:
+            if self.user_fn is not None:
+                target = self.user_fn
+            elif method == "__call__":
+                target = self.user
+            else:
+                target = getattr(self.user, method)
+            if inspect.iscoroutinefunction(target):
+                return await target(*args, **kwargs)
+            # Sync callables run off-loop: blocking user code must not stall
+            # the replica's event loop (concurrent requests keep flowing and
+            # queue pressure stays observable for autoscaling).
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: target(*args, **kwargs)
+            )
+            if inspect.iscoroutine(out):
+                out = await out
+            return out
+        finally:
+            with self._count_lock:
+                self._ongoing -= 1
