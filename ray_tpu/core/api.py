@@ -166,15 +166,41 @@ def init(
             server_thread.run_coro(
                 _prestart_workers(head, prestart)
             ).result(timeout=10)
+            server_thread.run_coro(head.restore_state()).result(timeout=30)
             server_thread.run_coro(head.start_periodic()).result(timeout=10)
             ctx.head_process = (head, server_thread)
             address = f"127.0.0.1:{port}"
             os.environ["RT_ADDRESS"] = address
+            # Discovery for out-of-process tooling (state CLI, job submit).
+            try:
+                os.makedirs("/tmp/ray_tpu", exist_ok=True)
+                with open("/tmp/ray_tpu/latest_address", "w") as f:
+                    f.write(address)
+            except OSError:
+                pass
 
         ctx.client = Client(address, kind="driver", pid=os.getpid())
         ctx.mode = "driver"
         ctx.session = ctx.client.session
         ctx.namespace = namespace
+        if os.environ.get("RT_LOG_TO_DRIVER", "1") != "0":
+            # Worker stdout/stderr arrive over pubsub (reference: the log
+            # monitor republishes worker logs to the driver).
+            def _print_worker_log(data):
+                try:
+                    prefix = f"(pid={data.get('pid')}) "
+                    import sys as _sys
+
+                    print(prefix + str(data.get("line", "")),
+                          file=_sys.stderr
+                          if data.get("stream") == "stderr" else _sys.stdout)
+                except Exception:
+                    pass
+
+            try:
+                ctx.client.subscribe("worker_logs", _print_worker_log)
+            except Exception:
+                pass
         atexit.register(shutdown)
         return ctx
 
@@ -433,6 +459,13 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._submit(self._name, args, kwargs, self._options)
 
+    def bind(self, upstream):
+        """Wire this method as a compiled-DAG step (reference:
+        dag/dag_node.py bind)."""
+        from ..dag.compiled import bind as _dag_bind
+
+        return _dag_bind(self, upstream)
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names: List[str],
@@ -443,7 +476,7 @@ class ActorHandle:
         self._class_name = class_name
 
     def __getattr__(self, name):
-        if name.startswith("_"):
+        if name.startswith("_") and name != "__rt_dag_exec_loop__":
             raise AttributeError(name)
         if name not in self._method_names:
             raise AttributeError(
@@ -515,7 +548,7 @@ class ActorClass:
         args_blob, arg_ids, args_ref = _pack_args(args, kwargs)
         method_names = [
             n for n, _ in inspect.getmembers(self._cls, callable)
-            if not n.startswith("__")
+            if not n.startswith("__") or n == "__rt_dag_exec_loop__"
         ]
         creation_task = {
             "task_id": task_id.binary(),

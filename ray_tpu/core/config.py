@@ -82,6 +82,11 @@ class Config:
     # -- RPC ------------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 * 1024 * 1024
+    # Control-plane persistence: when set, the head snapshots its durable
+    # state (KV table + named-actor specs) here and restores on startup —
+    # the analog of GCS fault tolerance via Redis-backed tables
+    # (reference: src/ray/gcs/store_client/redis_store_client.h:33).
+    head_state_path: str = ""
     # -- observability --------------------------------------------------------
     task_events_buffer_size: int = 100_000
     enable_timeline: bool = True

@@ -221,6 +221,8 @@ class Head:
         self.pending_pgs: "Dict[PlacementGroupID, dict]" = {}
         self._pending_frees: Dict[int, dict] = {}
         self._free_token = 0
+        self.metrics_by_pid: Dict[int, list] = {}
+        self._state_dirty = True  # persist once at startup when configured
         # Lineage: finished task specs kept (args pinned) so lost objects can
         # be recomputed by re-running their creating task (reference:
         # object_recovery_manager.h:90, reference_count.h:75).
@@ -236,7 +238,8 @@ class Head:
         for name in [
             "register", "kv_put", "kv_get", "kv_del", "kv_keys",
             "submit_task", "create_actor", "submit_actor_task",
-            "task_done", "stream_item", "put_object", "put_object_batch",
+            "task_done", "stream_item", "metrics_report",
+            "put_object", "put_object_batch",
             "get_objects",
             "wait_objects", "free_objects", "object_free_ack",
             "add_object_ref", "reconstruct_object",
@@ -345,6 +348,10 @@ class Head:
                 await asyncio.sleep(period)
                 now = time.monotonic()
                 self.store.tick()  # cooled freed segments -> warm pool
+                try:
+                    self.persist_state()
+                except Exception:
+                    pass
                 # Prune exited zygote-forked workers (orphans reaped by
                 # init) so shutdown never signals a recycled pid.
                 for pid in list(self.worker_pids):
@@ -438,6 +445,10 @@ class Head:
                 traceback.print_exc()
 
     async def stop(self):
+        try:
+            self.persist_state()
+        except Exception:
+            pass
         self._shutdown = True
         if self._periodic_task is not None:
             self._periodic_task.cancel()
@@ -596,6 +607,7 @@ class Head:
             self._kick()
             return {"session": self.session, "node_id": node_id.binary()}
         conn.meta["kind"] = kind  # driver
+        conn.meta["pid"] = body.get("pid")
         conn.meta["reader_node"] = self.local_node_id
         return {
             "session": self.session,
@@ -604,8 +616,12 @@ class Head:
 
     async def _on_disconnect(self, conn: Connection):
         worker_id = self.conn_to_worker.pop(conn.conn_id, None)
+        if conn.meta.get("pid") is not None:
+            self.metrics_by_pid.pop(conn.meta["pid"], None)
         if worker_id is not None:
             w = self.workers.get(worker_id)
+            if w is not None:
+                self.metrics_by_pid.pop(w.pid, None)
             if w is not None and w.pid in self.worker_pids:
                 # Exited zygote-forked worker: drop the pid now so a later
                 # shutdown can't signal a recycled pid.
@@ -659,10 +675,14 @@ class Head:
 
     # -- KV (reference: gcs_kv_manager.h) -------------------------------------
 
+    def _mark_dirty(self):
+        self._state_dirty = True
+
     async def h_kv_put(self, conn, body):
         key = body["key"]
         if body.get("overwrite", True) or key not in self.kv:
             self.kv[key] = body["value"]
+            self._mark_dirty()
             return {"added": True}
         return {"added": False}
 
@@ -670,7 +690,10 @@ class Head:
         return {"value": self.kv.get(body["key"])}
 
     async def h_kv_del(self, conn, body):
-        return {"deleted": self.kv.pop(body["key"], None) is not None}
+        deleted = self.kv.pop(body["key"], None) is not None
+        if deleted:
+            self._mark_dirty()
+        return {"deleted": deleted}
 
     async def h_kv_keys(self, conn, body):
         prefix = body.get("prefix", "")
@@ -712,6 +735,97 @@ class Head:
         rec.ref_count = max(rec.ref_count, 1)
         self._notify_object_ready(oid)
         return {}
+
+    # -- persistence (reference: redis_store_client.h — GCS tables survive a
+    # head restart; raylets/workers reconnect and replay) -------------------
+
+    def persist_state(self):
+        """Snapshot durable control-plane state: the KV table and the specs
+        of live named actors (recreated — fresh — on restore; their in-memory
+        state is the application's to checkpoint).  Only when dirty, and the
+        pickle+write runs off the event loop (a large KV must not stall
+        dispatch)."""
+        path = self.config.head_state_path
+        if not path or not self._state_dirty:
+            return
+        self._state_dirty = False
+        named = {}
+        for name, aid in self.named_actors.items():
+            actor = self.actors.get(aid)
+            if actor is not None and actor.state != "DEAD":
+                named[name] = actor.spec
+        snapshot = {"kv": dict(self.kv), "named_actors": named}
+
+        def dump():
+            import cloudpickle
+
+            blob = cloudpickle.dumps(snapshot)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+
+        try:
+            asyncio.get_running_loop().run_in_executor(None, dump)
+        except RuntimeError:
+            dump()  # no loop (e.g. called from stop() teardown path)
+
+    async def restore_state(self):
+        """Load a snapshot: KV merges in; named actors are re-created by
+        resubmitting their creation specs (args that lived in the old shm
+        session are gone — only inline-args actors restore)."""
+        path = self.config.head_state_path
+        if not path or not os.path.exists(path):
+            return
+        import cloudpickle
+
+        with open(path, "rb") as f:
+            state = cloudpickle.loads(f.read())
+        self.kv.update(state.get("kv", {}))
+        for name, spec in state.get("named_actors", {}).items():
+            if name in self.named_actors:
+                continue
+            ct = spec.get("creation_task", {})
+            if ct.get("arg_ids") or ct.get("args_ref"):
+                # Constructor args lived in the old session's shm — a
+                # resubmit would dep-block forever and wedge the name.
+                # Skip, so get_actor(name) fails fast instead.
+                continue
+            try:
+                await self.h_create_actor(None, spec)
+            except Exception:
+                pass
+
+    async def h_metrics_report(self, conn, body):
+        """Per-process metric snapshots; the head keeps the latest rows per
+        reporting pid and aggregates on read (reference: stats exported to
+        the node metrics agent, src/ray/stats/metric_exporter.h)."""
+        self.metrics_by_pid[body["pid"]] = body["rows"]
+        return {}
+
+    def metrics_rows(self) -> List[dict]:
+        """Aggregate across processes: counters/histogram counts sum, gauges
+        keep the per-process latest (tagged by pid when colliding)."""
+        agg: Dict[tuple, dict] = {}
+        for pid, rows in self.metrics_by_pid.items():
+            for r in rows:
+                key = (r["name"], tuple(sorted(r.get("tags", {}).items())))
+                cur = agg.get(key)
+                if cur is None:
+                    agg[key] = dict(r)
+                elif r["kind"] == "gauge":
+                    cur["value"] = r["value"]  # last writer wins
+                else:
+                    cur["value"] = cur.get("value", 0) + r.get("value", 0)
+                    if "sum" in r:
+                        cur["sum"] = cur.get("sum", 0) + r["sum"]
+                        cur["count"] = cur.get("count", 0) + r["count"]
+                        if r.get("buckets") and cur.get("buckets"):
+                            cur["buckets"] = [
+                                a + b for a, b in
+                                zip(cur["buckets"], r["buckets"])
+                            ]
+        return list(agg.values())
 
     async def h_put_object_batch(self, conn, body):
         """Registration batch for inline objects (client-side put buffering:
@@ -1435,6 +1549,7 @@ class Head:
             if actor:
                 if failed:
                     actor.state = "DEAD"
+                    self._mark_dirty()  # drop from the snapshot
                     actor.death_cause = body.get("error_repr", "creation failed")
                     await self._fail_actor_queue(actor, body.get("error"))
                     if worker:
@@ -1599,6 +1714,7 @@ class Head:
             if actor.name in self.named_actors:
                 raise ValueError(f"actor name {actor.name!r} already taken")
             self.named_actors[actor.name] = actor_id
+            self._mark_dirty()
         self.actors[actor_id] = actor
         await self.h_submit_task(conn, body["creation_task"])
         return {}
@@ -1690,6 +1806,7 @@ class Head:
         else:
             if actor.state != "DEAD":
                 actor.state = "DEAD"
+                self._mark_dirty()  # drop from the snapshot
                 actor.death_cause = "killed via kill_actor"
                 if actor.name:
                     self.named_actors.pop(actor.name, None)
@@ -1837,6 +1954,7 @@ class Head:
                         self.queued_tasks.append(ct2)
                 else:
                     actor.state = "DEAD"
+                    self._mark_dirty()  # drop from the snapshot
                     actor.death_cause = "worker process died"
                     if actor.name:
                         self.named_actors.pop(actor.name, None)
@@ -2027,6 +2145,8 @@ class Head:
             )}
         if kind == "timeline":
             return {"items": list(self.task_events)}
+        if kind == "metrics":
+            return {"items": self.metrics_rows()}
         raise ValueError(f"unknown state kind {kind!r}")
 
     async def h_shutdown_cluster(self, conn, body):

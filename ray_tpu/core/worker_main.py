@@ -41,6 +41,62 @@ from .object_ref import ObjectRef, _TopLevelRef
 _DEBUG_PUSH = bool(os.environ.get("RT_DEBUG_PUSH"))
 
 
+class _LogTee:
+    """Mirrors a worker stream to the driver via pubsub (reference:
+    _private/log_monitor.py tails worker logs and republishes to the driver
+    over GCS pubsub; here the worker pushes lines itself)."""
+
+    def __init__(self, stream, client, kind: str):
+        self._stream = stream
+        self._client = client
+        self._kind = kind
+        self._buf = ""
+        self._buf_lock = threading.Lock()
+        self._local = threading.local()
+        # Own in-flight window: log lines must never poison the client's
+        # shared bg-error channel or block a task — past the window they
+        # drop (the log file keeps the full copy).
+        self._inflight: list = []
+
+    def write(self, s):
+        n = self._stream.write(s)
+        if getattr(self._local, "publishing", False):
+            return n  # a publish-path print must not recurse
+        lines = []
+        with self._buf_lock:
+            self._buf += s
+            while "\n" in self._buf:
+                line, self._buf = self._buf.split("\n", 1)
+                if line.strip():
+                    lines.append(line)
+        for line in lines:
+            self._local.publishing = True
+            try:
+                self._inflight = [f for f in self._inflight if not f.done()]
+                if len(self._inflight) >= 200:
+                    continue  # head is behind: drop rather than block
+                self._inflight.append(self._client.rpc.call_async(
+                    "publish", {
+                        "topic": "worker_logs",
+                        "data": {"pid": os.getpid(), "stream": self._kind,
+                                 "actor": ctx.current_actor_id.hex()[:8]
+                                 if ctx.current_actor_id else None,
+                                 "line": line},
+                    }
+                ))
+            except Exception:
+                pass
+            finally:
+                self._local.publishing = False
+        return n
+
+    def flush(self):
+        self._stream.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._stream, name)
+
+
 class Worker:
     def __init__(self):
         self.head_addr = os.environ["RT_HEAD_ADDR"]
@@ -91,6 +147,11 @@ class Worker:
             lambda b: self.client.rpc.call_async("health_ack", {}),
         )
         self.client.rpc.on_connection_lost = lambda: os._exit(0)
+        # Stream this worker's stdout/stderr to the driver (log files keep
+        # the full copy); RT_LOG_TO_DRIVER=0 disables.
+        if os.environ.get("RT_LOG_TO_DRIVER", "1") != "0":
+            sys.stdout = _LogTee(sys.stdout, self.client, "stdout")
+            sys.stderr = _LogTee(sys.stderr, self.client, "stderr")
         # Handshake: only now may the head lease us (push handlers installed).
         self.client.call("worker_ready", {})
 

@@ -1,0 +1,15 @@
+"""ray_tpu.dag: compiled static actor pipelines (reference: ray.dag)."""
+
+from .channel import ShmChannel
+from .compiled import (
+    CompiledDAG,
+    DagNode,
+    InputNode,
+    bind,
+    enable_compiled_dags,
+)
+
+__all__ = [
+    "InputNode", "DagNode", "CompiledDAG", "bind", "enable_compiled_dags",
+    "ShmChannel",
+]

@@ -1,0 +1,174 @@
+"""Compiled DAGs: static actor pipelines over shm channels.
+
+Role-equivalent to the reference's accelerated DAGs
+(reference: python/ray/dag/dag_node.py:162 experimental_compile ->
+compiled_dag_node.py:498 CompiledDAG with per-actor execution loops
+do_exec_tasks:95 and shared-memory channels): after compile, an execution
+moves data actor-to-actor through preallocated shm channels with zero
+control-plane round trips — the TPU-first analog of NCCL p2p channels is
+simply that channel payloads are host arrays headed for jax.device_put.
+
+MVP surface: bind actor methods into a chain/graph with one input and one
+output, single-node (all channel endpoints share /dev/shm).
+
+    with InputNode() as inp:
+        x = preprocess.process.bind(inp)
+        out = model.infer.bind(x)
+    dag = out.experimental_compile()
+    result = dag.execute(batch)       # -> value (synchronous)
+    dag.teardown()
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ..core import serialization
+from .channel import ShmChannel
+
+
+class DagNode:
+    def __init__(self, upstream: Optional["DagNode"]):
+        self.upstream = upstream
+
+    def experimental_compile(self, channel_capacity: int = 8 * 1024 * 1024):
+        chain: List[DagNode] = []
+        node: Optional[DagNode] = self
+        while node is not None:
+            chain.append(node)
+            node = node.upstream
+        chain.reverse()
+        if not isinstance(chain[0], InputNode):
+            raise ValueError("DAG must start from an InputNode")
+        steps = chain[1:]
+        if not steps or not all(isinstance(s, ClassMethodNode) for s in steps):
+            raise ValueError("DAG steps must be bound actor methods")
+        return CompiledDAG(steps, channel_capacity)
+
+
+class InputNode(DagNode):
+    """The DAG's input placeholder (reference: dag/input_node.py)."""
+
+    def __init__(self):
+        super().__init__(None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClassMethodNode(DagNode):
+    def __init__(self, actor, method_name: str, upstream: DagNode):
+        super().__init__(upstream)
+        self.actor = actor
+        self.method_name = method_name
+
+
+def bind(actor_method, arg: DagNode) -> ClassMethodNode:
+    """`actor.method.bind(node)` — wires one pipeline step."""
+    if not isinstance(arg, DagNode):
+        raise TypeError("bind() takes the upstream DagNode")
+    return ClassMethodNode(
+        actor_method._handle, actor_method._name, arg
+    )
+
+
+class CompiledDAG:
+    def __init__(self, steps: List[ClassMethodNode], channel_capacity: int):
+        self._steps = steps
+        token = uuid.uuid4().hex[:12]
+        n = len(steps)
+        self._paths = [
+            f"/dev/shm/rtdag-{token}-{i}" for i in range(n + 1)
+        ]
+        self._channels = [
+            ShmChannel(p, channel_capacity, create=True) for p in self._paths
+        ]
+        # Each actor runs a dedicated exec loop reading its input channel and
+        # writing its output channel (reference: do_exec_tasks per-actor
+        # loops).  The loop call occupies one actor concurrency slot for the
+        # DAG's lifetime.
+        self._loop_refs = [
+            step.actor.__rt_dag_exec_loop__.remote(
+                step.method_name, self._paths[i], self._paths[i + 1],
+            )
+            for i, step in enumerate(self._steps)
+        ]
+        self._lock = threading.Lock()
+        self._torn_down = False
+
+    def execute(self, value: Any, timeout: float = 60.0) -> Any:
+        with self._lock:
+            if self._torn_down:
+                raise RuntimeError("DAG was torn down")
+            self._channels[0].write_bytes(
+                serialization.pack(value), timeout=timeout
+            )
+            out_ch = self._channels[-1]
+            view = out_ch.read_bytes(timeout=timeout)
+            try:
+                result = serialization.unpack(bytes(view))
+            finally:
+                view.release()
+                out_ch.done_reading()
+        if isinstance(result, _DagError):
+            raise result.error
+        return result
+
+    def teardown(self):
+        with self._lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+            self._channels[0].close_writer()
+            try:
+                ray_tpu.get(self._loop_refs, timeout=30)
+            except Exception:
+                pass
+            for ch in self._channels:
+                ch.close(unlink=True)
+
+
+class _DagError:
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+def _dag_exec_loop(self, method_name: str, in_path: str, out_path: str):
+    """Injected actor method: the per-actor compiled-DAG execution loop."""
+    inp = ShmChannel(in_path)
+    out = ShmChannel(out_path)
+    method = getattr(self, method_name)
+    try:
+        while True:
+            try:
+                view = inp.read_bytes(timeout=3600.0)
+            except EOFError:
+                out.close_writer()
+                return "closed"
+            try:
+                value = serialization.unpack(bytes(view))
+            finally:
+                view.release()
+                inp.done_reading()
+            try:
+                result = method(value)
+            except BaseException as e:  # noqa: BLE001 — ships to the driver
+                result = _DagError(e)
+            out.write_bytes(serialization.pack(result))
+    finally:
+        inp.close()
+        out.close()
+
+
+def enable_compiled_dags(actor_class):
+    """Class decorator: make an actor class usable in compiled DAGs (adds
+    the exec-loop method; bind via `actor.method.bind(node)`)."""
+    actor_class._cls.__rt_dag_exec_loop__ = _dag_exec_loop
+    return actor_class

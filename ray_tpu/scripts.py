@@ -1,0 +1,175 @@
+"""Operator CLI: `python -m ray_tpu <command>`.
+
+Role-equivalent to the reference's `ray` CLI + state API commands
+(reference: python/ray/scripts/scripts.py:76, util/state/api.py:781 `ray
+list ...`, `ray summary`, `ray timeline`, `ray status`): inspects a running
+cluster over the control-plane RPC.  The address comes from --address,
+RT_ADDRESS, or /tmp/ray_tpu/latest_address (written by init()).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _resolve_address(addr: Optional[str]) -> str:
+    if addr:
+        return addr
+    if os.environ.get("RT_ADDRESS"):
+        return os.environ["RT_ADDRESS"]
+    try:
+        with open("/tmp/ray_tpu/latest_address") as f:
+            return f.read().strip()
+    except OSError:
+        raise SystemExit(
+            "no cluster address (use --address, RT_ADDRESS, or start a "
+            "cluster first)"
+        )
+
+
+def _client(addr: Optional[str]):
+    from .core.client import Client
+
+    return Client(_resolve_address(addr), kind="driver", pid=os.getpid())
+
+
+def _print_table(rows, columns):
+    if not rows:
+        print("(empty)")
+        return
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+        for c in columns
+    }
+    print("  ".join(c.upper().ljust(widths[c]) for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
+
+
+_LIST_COLUMNS = {
+    "actors": ["actor_id", "class_name", "state", "name", "pid",
+               "num_executed_tasks"],
+    "tasks": ["task_id", "name", "state", "error"],
+    "nodes": ["node_id", "alive", "resources", "available"],
+    "workers": ["worker_id", "node_id", "state", "pid"],
+    "objects": ["object_id", "size", "sealed", "inline", "ref_count"],
+    "placement_groups": ["pg_id", "strategy", "created", "name"],
+}
+
+
+def cmd_list(args) -> int:
+    kind = {"pgs": "placement_groups"}.get(args.kind, args.kind)
+    cl = _client(args.address)
+    try:
+        items = cl.call("list_state", {"kind": kind})["items"]
+        if args.json:
+            print(json.dumps(items, indent=1, default=str))
+        else:
+            _print_table(items, _LIST_COLUMNS.get(
+                kind, sorted(items[0].keys()) if items else []
+            ))
+    finally:
+        cl.close()
+    return 0
+
+
+def cmd_status(args) -> int:
+    cl = _client(args.address)
+    try:
+        nodes = cl.call("list_state", {"kind": "nodes"})["items"]
+        workers = cl.call("list_state", {"kind": "workers"})["items"]
+        actors = cl.call("list_state", {"kind": "actors"})["items"]
+        total = cl.call("cluster_resources")["resources"]
+        avail = cl.call("available_resources")["resources"]
+        print(f"nodes: {sum(1 for n in nodes if n.get('alive'))} alive / "
+              f"{len(nodes)}")
+        print(f"workers: {len(workers)}  actors: "
+              f"{sum(1 for a in actors if a['state'] == 'ALIVE')} alive")
+        for res in sorted(total):
+            used = total[res] - avail.get(res, 0)
+            print(f"  {res}: {used:g}/{total[res]:g} used")
+    finally:
+        cl.close()
+    return 0
+
+
+def cmd_summary(args) -> int:
+    """Task summary by name+state (reference: `ray summary tasks`)."""
+    cl = _client(args.address)
+    try:
+        items = cl.call("list_state", {"kind": "tasks"})["items"]
+        agg = {}
+        for t in items:
+            key = (t.get("name", ""), t.get("state", ""))
+            agg[key] = agg.get(key, 0) + 1
+        rows = [
+            {"name": k[0], "state": k[1], "count": v}
+            for k, v in sorted(agg.items())
+        ]
+        _print_table(rows, ["name", "state", "count"])
+    finally:
+        cl.close()
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    cl = _client(args.address)
+    try:
+        rows = cl.call("list_state", {"kind": "metrics"})["items"]
+        if args.prometheus:
+            from .util.metrics import prometheus_text
+
+            sys.stdout.write(prometheus_text(rows))
+        else:
+            _print_table(rows, ["name", "kind", "tags", "value"])
+    finally:
+        cl.close()
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    cl = _client(args.address)
+    try:
+        items = cl.call("list_state", {"kind": "timeline"})["items"]
+        print(json.dumps(items, indent=1, default=str))
+    finally:
+        cl.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    ap.add_argument("--address", default=None)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=[
+        "actors", "tasks", "nodes", "workers", "objects",
+        "placement_groups", "pgs",
+    ])
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("status", help="cluster resource summary")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("summary", help="task summary by name+state")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("metrics", help="aggregated user metrics")
+    p.add_argument("--prometheus", action="store_true")
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("timeline", help="task event timeline (json)")
+    p.set_defaults(fn=cmd_timeline)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""ray_tpu.util: metrics, state helpers (reference: ray.util)."""
+
+from . import metrics
+
+__all__ = ["metrics"]

@@ -1,0 +1,161 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Role-equivalent to the reference's ray.util.metrics
+(reference: python/ray/util/metrics.py backed by the C++ OpenCensus stats
+pipeline, src/ray/stats/metric.h): metric instruments are process-local and
+a background flusher ships deltas to the head, which aggregates across
+processes.  `list_state(kind="metrics")` (and the CLI `metrics` command)
+reads the aggregate; `prometheus_text()` renders the exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_instruments: List["_Metric"] = []
+_flusher_started = False
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Metric:
+    kind = "counter"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _instruments.append(self)
+        _ensure_flusher()
+
+    def _snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"name": self.name, "kind": self.kind,
+                 "description": self.description,
+                 "tags": dict(k), "value": v}
+                for k, v in self._values.items()
+            ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        k = _tags_key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_tags_key(tags)] = value
+
+
+class Histogram(_Metric):
+    """Fixed-boundary histogram; value snapshot ships bucket counts + sum."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (),
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries) or (
+            0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10,
+        )
+        self._buckets: Dict[Tuple, List[float]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = _tags_key(tags)
+        with self._lock:
+            b = self._buckets.setdefault(
+                k, [0.0] * (len(self.boundaries) + 1)
+            )
+            for i, bound in enumerate(self.boundaries):
+                if value <= bound:
+                    b[i] += 1
+                    break
+            else:
+                b[-1] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def _snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"name": self.name, "kind": self.kind,
+                 "description": self.description, "tags": dict(k),
+                 "boundaries": list(self.boundaries),
+                 "buckets": list(self._buckets.get(k, [])),
+                 "sum": self._sums.get(k, 0.0),
+                 "count": self._counts.get(k, 0),
+                 "value": self._counts.get(k, 0)}
+                for k in self._counts
+            ]
+
+
+def _flush_once():
+    from ..core.context import ctx
+
+    if ctx.client is None:
+        return
+    with _registry_lock:
+        instruments = list(_instruments)
+    rows = []
+    for m in instruments:
+        rows.extend(m._snapshot())
+    if rows:
+        try:
+            ctx.client.call_bg("metrics_report", {
+                "pid": __import__("os").getpid(),
+                "rows": rows,
+            })
+        except Exception:
+            pass
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(2.0)
+            _flush_once()
+
+    threading.Thread(target=loop, daemon=True, name="metrics-flush").start()
+
+
+def prometheus_text(rows: List[dict]) -> str:
+    """Render aggregated metric rows in the Prometheus exposition format
+    (reference: _private/prometheus_exporter.py)."""
+    out = []
+    seen = set()
+    for r in rows:
+        if r["name"] not in seen:
+            seen.add(r["name"])
+            if r.get("description"):
+                out.append(f"# HELP {r['name']} {r['description']}")
+            out.append(f"# TYPE {r['name']} {r['kind']}")
+        tag_s = ",".join(f'{k}="{v}"' for k, v in r.get("tags", {}).items())
+        label = f"{{{tag_s}}}" if tag_s else ""
+        out.append(f"{r['name']}{label} {r['value']}")
+    return "\n".join(out) + "\n"

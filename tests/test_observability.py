@@ -1,0 +1,164 @@
+"""Observability + persistence + job submission tests.
+
+Reference analogs: util/metrics tests, _private/log_monitor streaming,
+util/state CLI (`ray list`/`ray status`), GCS Redis persistence tests,
+dashboard/modules/job tests.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_metrics_aggregate_across_processes(rt):
+    @ray_tpu.remote
+    def work(i):
+        from ray_tpu.util.metrics import Counter, Gauge, _flush_once
+
+        c = Counter("tasks_finished", description="done tasks",
+                    tag_keys=("kind",))
+        c.inc(1, tags={"kind": "work"})
+        g = Gauge("last_i")
+        g.set(i)
+        _flush_once()
+        from ray_tpu.core.context import ctx
+
+        ctx.client.drain_bg()
+        return i
+
+    assert sorted(ray_tpu.get([work.remote(i) for i in range(4)])) == [0, 1, 2, 3]
+    from ray_tpu.core.context import ctx
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rows = ctx.client.call("list_state", {"kind": "metrics"})["items"]
+        counters = [r for r in rows if r["name"] == "tasks_finished"]
+        if counters and counters[0]["value"] >= 4:
+            break
+        time.sleep(0.2)
+    assert counters and counters[0]["value"] == 4  # summed across workers
+    assert counters[0]["tags"] == {"kind": "work"}
+
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text(rows)
+    assert 'tasks_finished{kind="work"} 4' in text
+
+
+def test_worker_logs_stream_to_driver(rt, capfd):
+    @ray_tpu.remote
+    def shout():
+        print("HELLO-FROM-WORKER")
+        return 1
+
+    assert ray_tpu.get(shout.remote()) == 1
+    deadline = time.time() + 10
+    seen = ""
+    while time.time() < deadline:
+        seen += capfd.readouterr().out
+        if "HELLO-FROM-WORKER" in seen:
+            break
+        time.sleep(0.2)
+    assert "HELLO-FROM-WORKER" in seen
+    assert "(pid=" in seen  # prefixed with the worker pid
+
+
+def test_state_cli(rt):
+    @ray_tpu.remote
+    class Keeper:
+        def ping(self):
+            return "ok"
+
+    k = Keeper.options(name="cli-keeper").remote()
+    assert ray_tpu.get(k.ping.remote()) == "ok"
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "--address",
+         os.environ["RT_ADDRESS"], "list", "actors"],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "Keeper" in out.stdout and "cli-keeper" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "--address",
+         os.environ["RT_ADDRESS"], "status"],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "nodes: 1 alive" in out.stdout
+
+
+def test_head_state_persistence(tmp_path):
+    state = str(tmp_path / "head.state")
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, system_config={"head_state_path": state})
+    from ray_tpu.core.context import ctx
+
+    ctx.client.kv_put("persisted-key", b"persisted-value")
+
+    @ray_tpu.remote
+    class Durable:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def get_tag(self):
+            return self.tag
+
+    d = Durable.options(name="durable-actor", lifetime="detached").remote("v1")
+    assert ray_tpu.get(d.get_tag.remote()) == "v1"
+    ray_tpu.shutdown()
+
+    # "Restarted" head restores KV and re-creates the named actor.
+    ray_tpu.init(num_cpus=2, system_config={"head_state_path": state})
+    from ray_tpu.core.context import ctx as ctx2
+
+    assert ctx2.client.kv_get("persisted-key") == b"persisted-value"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            d2 = ray_tpu.get_actor("durable-actor")
+            assert ray_tpu.get(d2.get_tag.remote(), timeout=30) == "v1"
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        pytest.fail("named actor not restored from head state")
+    ray_tpu.shutdown()
+
+
+def test_job_submission(rt):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job ran ok'); print(6*7)\"",
+    )
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == "SUCCEEDED"
+    logs = client.get_job_logs(job_id)
+    assert "job ran ok" in logs and "42" in logs
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+
+def test_job_failure_status(rt):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert client.wait_until_finished(job_id, timeout=120) == "FAILED"
