@@ -23,9 +23,22 @@ import os
 import sys
 import time
 
-# The control plane, not JAX, is under test; keep workers light and on CPU.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The control plane, not JAX, is under test; keep everything on CPU.  Forced
+# through jax's own config, not just the env var: an accelerator-tunnel
+# sitecustomize may have imported jax (binding jax_platforms) before this
+# module runs.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("RT_PRESTART_WORKERS", "8")
+
+import jax  # noqa: E402
+
+try:
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -252,14 +265,23 @@ def bench_rllib(quick: bool):
     env-steps/s; reference harness rllib/benchmarks/ppo)."""
     from ray_tpu.rllib import PPOConfig
 
+    import jax
+
+    print(f"# rllib learner backend: {jax.default_backend()}",
+          file=sys.stderr)
     algo = (PPOConfig()
             .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
                          rollout_fragment_length=128)
             .build())
     try:
         algo.train()  # compile + warmup
-        rates = [algo.train()["env_steps_per_sec"]
-                 for _ in range(3 if quick else 10)]
+        rates = []
+        for _ in range(3 if quick else 10):
+            r = algo.train()
+            rates.append(r["env_steps_per_sec"])
+            print(f"# ppo iter: sps={r['env_steps_per_sec']:.0f} "
+                  f"sample={r['time_sample_s']:.2f}s "
+                  f"learn={r['time_learn_s']:.2f}s", file=sys.stderr)
         record("ppo_env_steps_per_sec",
                float(np.median(rates)), "steps/s")
     finally:
@@ -275,9 +297,15 @@ def main():
 
     ray_tpu.init(num_cpus=8)
     bench_single_node(args.quick)
-    if args.rllib:
-        bench_rllib(args.quick)
     ray_tpu.shutdown()
+
+    if args.rllib:
+        # Fresh cluster after the old one's worker fleet fully exits:
+        # leftover process churn skews env-runner scheduling.
+        time.sleep(5)
+        ray_tpu.init(num_cpus=8)
+        bench_rllib(args.quick)
+        ray_tpu.shutdown()
 
     if not args.skip_multinode:
         bench_cross_node(args.quick)
