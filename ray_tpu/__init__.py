@@ -36,6 +36,23 @@ from . import exceptions
 
 __version__ = "0.1.0"
 
+_SUBPACKAGES = (
+    "data", "train", "tune", "serve", "rllib", "workflow", "dag",
+    "collective", "util", "job_submission", "cluster_utils",
+)
+
+
+def __getattr__(name):
+    """Lazy subpackage access: `ray_tpu.tune`, `ray_tpu.serve`, ... import
+    on first touch (heavy deps like jax stay unloaded until needed)."""
+    if name in _SUBPACKAGES:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "list_named_actors", "placement_group",

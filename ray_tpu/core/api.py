@@ -361,6 +361,46 @@ def _pack_args(args: tuple, kwargs: dict):
     return None, arg_ids, oid.binary()
 
 
+def _process_runtime_env(renv: Optional[dict]) -> Optional[dict]:
+    """Upload runtime_env payloads once (content-addressed in the cluster
+    KV) and rewrite the env to reference them.  Supported: env_vars,
+    working_dir (reference: _private/runtime_env/working_dir.py — the dir is
+    packaged, cached by URI, and extracted on the worker)."""
+    if not renv or "working_dir" not in renv:
+        return renv
+    import io
+    import zipfile
+
+    renv = dict(renv)
+    wd = renv.pop("working_dir")
+    buf = io.BytesIO()
+    n_files = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(wd):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            for fname in files:
+                full = os.path.join(root, fname)
+                zf.write(full, os.path.relpath(full, wd))
+                n_files += 1
+    if n_files == 0:
+        raise ValueError(
+            f"runtime_env working_dir {wd!r} is empty or does not exist"
+        )
+    blob = buf.getvalue()
+    if len(blob) > 64 * 1024 * 1024:
+        raise ValueError(
+            f"working_dir archive is {len(blob)} bytes (>64MiB); ship large "
+            "assets through the object store or shared storage instead"
+        )
+    key = f"wd:{hashlib.sha1(blob).hexdigest()}"
+    if key not in ctx.client.exported_keys:
+        ctx.client.kv_put(key, blob, overwrite=False)
+        ctx.client.exported_keys.add(key)
+    renv["working_dir_key"] = key
+    return renv
+
+
 _VALID_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
     "retry_exceptions", "name", "scheduling_strategy", "runtime_env",
@@ -385,6 +425,7 @@ class RemoteFunction:
         self._options = options
         self._exported_key: Optional[str] = None
         self._fn_blob: Optional[bytes] = None
+        self._renv_cache: Optional[dict] = None  # processed runtime_env
         self.__name__ = getattr(fn, "__name__", "anonymous")
 
     def options(self, **overrides):
@@ -395,6 +436,15 @@ class RemoteFunction:
         rf = RemoteFunction(self._fn, merged)
         rf._fn_blob = self._fn_blob
         return rf
+
+    def _renv(self):
+        # Options are immutable per instance: package the working_dir once,
+        # not once per .remote() (reference: URI-cached runtime-env packages).
+        if self._renv_cache is None:
+            self._renv_cache = _process_runtime_env(
+                self._options.get("runtime_env")
+            ) or {}
+        return self._renv_cache or None
 
     def remote(self, *args, **kwargs):
         _ensure_init()
@@ -424,7 +474,7 @@ class RemoteFunction:
             "strategy": _strategy_wire(o.get("scheduling_strategy")),
             "max_retries": o.get("max_retries", cfg.default_task_max_retries),
             "retry_exceptions": bool(o.get("retry_exceptions", False)),
-            "runtime_env": o.get("runtime_env"),
+            "runtime_env": self._renv(),
         }
         # Submission is pipelined: the ref is returned immediately and the
         # spec rides the ordered connection (reference: task submission is
@@ -526,6 +576,7 @@ class ActorClass:
         self._cls = cls
         self._options = options
         self._cls_blob: Optional[bytes] = None
+        self._renv_cache: Optional[dict] = None  # processed runtime_env
         self.__name__ = cls.__name__
 
     def options(self, **overrides):
@@ -535,6 +586,13 @@ class ActorClass:
         ac = ActorClass(self._cls, {**self._options, **overrides})
         ac._cls_blob = self._cls_blob
         return ac
+
+    def _renv(self):
+        if self._renv_cache is None:
+            self._renv_cache = _process_runtime_env(
+                self._options.get("runtime_env")
+            ) or {}
+        return self._renv_cache or None
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         _ensure_init()
@@ -567,7 +625,7 @@ class ActorClass:
             "is_actor_creation": True,
             "actor_id": actor_id.binary(),
             "max_concurrency": o.get("max_concurrency", 1),
-            "runtime_env": o.get("runtime_env"),
+            "runtime_env": self._renv(),
         }
         spec = {
             "actor_id": actor_id.binary(),

@@ -190,6 +190,32 @@ class Worker:
             }
         return args, kwargs
 
+    def _setup_working_dir(self, key: str):
+        """Extract a content-addressed working_dir archive (cached per key)
+        and enter it (reference: runtime_env/working_dir.py — URI-cached
+        package, extracted and prepended to sys.path)."""
+        dest = os.path.join("/tmp/ray_tpu_wd", key.split(":", 1)[1])
+        if not os.path.isdir(dest):
+            import io
+            import zipfile
+
+            blob = self.client.kv_get(key)
+            if blob is None:
+                raise RuntimeError(f"working_dir archive {key} not found")
+            tmp = dest + f".tmp-{os.getpid()}"
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, dest)
+            except OSError:  # raced another worker: theirs is identical
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        os.chdir(dest)
+        if dest not in sys.path:
+            sys.path.insert(0, dest)
+        return dest
+
     # -------------------------------------------------------------- reporting
 
     def _store_value(self, oid: ObjectID, value) -> dict:
@@ -244,6 +270,8 @@ class Worker:
         ctx.current_task_id = TaskID(task_id)
         self.running_threads[task_id] = threading.get_ident()
         saved_env: Dict[str, Optional[str]] = {}
+        saved_cwd: Optional[str] = None
+        saved_wd_path: Optional[str] = None
         try:
             if task_id in self.cancelled:
                 raise exceptions.TaskCancelledError(TaskID(task_id).hex())
@@ -252,6 +280,11 @@ class Worker:
             saved_env = {k: os.environ.get(k) for k in env_vars}
             for k, v in env_vars.items():
                 os.environ[k] = v
+            if renv.get("working_dir_key"):
+                saved_cwd = os.getcwd()
+                saved_wd_path = self._setup_working_dir(
+                    renv["working_dir_key"]
+                )
 
             if spec.get("is_actor_creation"):
                 cls = self._load(spec["func_key"])
@@ -317,13 +350,23 @@ class Worker:
                 )
         finally:
             # Actor processes keep their runtime_env; pooled task workers
-            # restore so env vars don't leak into unrelated tasks.
+            # restore so env vars / cwd / sys.path don't leak into unrelated
+            # tasks.  (The module import cache can still carry working_dir
+            # modules across tasks — matching the reference's per-worker
+            # caching semantics; distinct envs should use distinct workers.)
             if self.actor_instance is None:
                 for k, old in saved_env.items():
                     if old is None:
                         os.environ.pop(k, None)
                     else:
                         os.environ[k] = old
+                if saved_cwd is not None:
+                    try:
+                        os.chdir(saved_cwd)
+                    except OSError:
+                        pass
+                    if saved_wd_path in sys.path:
+                        sys.path.remove(saved_wd_path)
             self.running_threads.pop(task_id, None)
             ctx.current_task_id = None
             if _DEBUG_PUSH:

@@ -56,6 +56,9 @@ class DataParallelTrainer:
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
+        # Optional hook: called with each report round's metrics (the Tuner
+        # bridges this to tune.report so ASHA can early-stop trainer trials).
+        self._report_callback = None
 
     # ------------------------------------------------------------------ fit
 
@@ -164,6 +167,8 @@ class DataParallelTrainer:
                         shutil.rmtree(d, ignore_errors=True)
                 last_metrics = metrics
                 history.append(metrics)
+                if self._report_callback is not None:
+                    self._report_callback(metrics)
                 group.ack_all([r["rank"] for r in reports])
         return last_metrics, history
 

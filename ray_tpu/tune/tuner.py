@@ -256,8 +256,58 @@ class TuneConfig:
 # -------------------------------------------------------------------- Tuner
 
 
+def _trainer_trainable(trainer) -> Callable:
+    """Adapt a DataParallelTrainer into a function trainable: each trial
+    runs trainer.fit() with the trial's `train_loop_config` merged in
+    (reference: BaseTrainer.fit wraps itself as_trainable and runs through
+    Tune, train/base_trainer.py:111,567)."""
+
+    def fn(config):
+        import copy
+
+        from ..train.config import RunConfig as TrainRunConfig
+
+        t = copy.copy(trainer)
+        loop_cfg = dict(t.train_loop_config or {})
+        loop_cfg.update(config.get("train_loop_config", config) or {})
+        t.train_loop_config = loop_cfg
+        base_run = t.run_config or TrainRunConfig()
+        t.run_config = TrainRunConfig(
+            name="train",
+            storage_path=get_trial_dir(),
+            failure_config=base_run.failure_config,
+            checkpoint_config=base_run.checkpoint_config,
+        )
+        # Bridge intermediate train.report rounds to the tune session so
+        # schedulers (ASHA) can stop bad trials mid-run — a final-only
+        # report would make early stopping inert.
+        t._report_callback = report
+        result = t.fit()
+        if result.error is not None:
+            raise result.error
+        return dict(result.metrics)
+
+    return fn
+
+
+def _trainer_trial_resources(trainer, per_trial: Dict[str, float]) -> Dict[str, float]:
+    """A trainer trial holds its own actor PLUS a nested worker gang; the
+    concurrency cap must count both or trials saturate the cluster and the
+    gangs inside them can never start (deadlock)."""
+    eff = dict(per_trial)
+    sc = trainer.scaling_config
+    worker_res = sc.worker_resources()
+    for res, amt in worker_res.items():
+        eff[res] = eff.get(res, 0.0) + amt * sc.num_workers
+    return eff
+
+
 class Tuner:
-    """(reference: tune/tuner.py:44 Tuner; fit -> tune_controller loop)"""
+    """(reference: tune/tuner.py:44 Tuner; fit -> tune_controller loop).
+
+    `trainable` may be a plain function taking a config dict or a
+    DataParallelTrainer/JaxTrainer instance (each trial runs fit() with the
+    trial's train_loop_config merged in)."""
 
     def __init__(
         self,
@@ -354,15 +404,47 @@ class Tuner:
             ]
         self._save_state()
 
-        fn_blob = _dumps_by_value(self.trainable)
+        from ..train.trainer import DataParallelTrainer
+
+        trainable = self.trainable
+        if isinstance(trainable, DataParallelTrainer):
+            # Serialize by value against the USER's module (the train loop's
+            # defining module, typically a driver script workers can't
+            # import), then wrap.
+            import cloudpickle as _cp
+            import sys as _sys
+
+            mod = _sys.modules.get(
+                getattr(trainable.train_loop, "__module__", None)
+            )
+            registered = False
+            if mod is not None and mod.__name__ != "__main__":
+                try:
+                    _cp.register_pickle_by_value(mod)
+                    registered = True
+                except Exception:
+                    pass
+            try:
+                fn_blob = _cp.dumps(_trainer_trainable(trainable))
+            finally:
+                if registered:
+                    try:
+                        _cp.unregister_pickle_by_value(mod)
+                    except Exception:
+                        pass
+        else:
+            fn_blob = _dumps_by_value(trainable)
         scheduler = cfg.scheduler
         # Placement capacity across every requested resource dimension: an
         # actor beyond capacity would never start and its poll would stall
-        # the controller.
+        # the controller.  Trainer trials count their nested worker gang.
         cluster = ray_tpu.cluster_resources()
+        per_trial = cfg.resources_per_trial
+        if isinstance(self.trainable, DataParallelTrainer):
+            per_trial = _trainer_trial_resources(self.trainable, per_trial)
         capacity = min(
             (int(cluster.get(res, 0) // amt)
-             for res, amt in cfg.resources_per_trial.items() if amt > 0),
+             for res, amt in per_trial.items() if amt > 0),
             default=1,
         )
         capacity = max(1, capacity)

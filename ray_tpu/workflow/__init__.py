@@ -1,0 +1,169 @@
+"""ray_tpu.workflow: durable workflows on top of tasks.
+
+Role-equivalent to the reference's workflow library
+(reference: python/ray/workflow/api.py:123 run/:177 run_async,
+workflow_executor.py, workflow_storage.py — steps execute as tasks, every
+step's result is persisted, and re-running the same workflow_id resumes from
+the last completed step instead of recomputing).
+
+    a = workflow.step(load)(path)
+    b = workflow.step(transform)(a)
+    result = workflow.run(b, workflow_id="etl-1")   # crash-safe
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+DEFAULT_STORAGE = "/tmp/ray_tpu_workflows"
+
+
+class StepNode:
+    """One step: a function applied to values and/or upstream steps."""
+
+    def __init__(self, fn: Callable, args: tuple, kwargs: dict):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = getattr(fn, "__name__", "step")
+
+    def _upstream(self) -> List["StepNode"]:
+        ups = [a for a in self.args if isinstance(a, StepNode)]
+        ups += [v for v in self.kwargs.values() if isinstance(v, StepNode)]
+        return ups
+
+
+def step(fn: Callable) -> Callable[..., StepNode]:
+    """Wrap a function so calls build workflow steps (reference:
+    the DAG-node binding layer of workflow.run)."""
+
+    def make(*args, **kwargs) -> StepNode:
+        return StepNode(fn, args, kwargs)
+
+    make.__name__ = getattr(fn, "__name__", "step")
+    return make
+
+
+class _Storage:
+    """File-per-step result store (reference: workflow_storage.py)."""
+
+    def __init__(self, workflow_id: str, base: Optional[str]):
+        self.dir = os.path.join(base or DEFAULT_STORAGE, workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, step_id: str) -> str:
+        return os.path.join(self.dir, f"{step_id}.pkl")
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(self._path(step_id))
+
+    def load(self, step_id: str) -> Any:
+        with open(self._path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, step_id: str, value: Any):
+        tmp = self._path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, self._path(step_id))
+
+
+def _topo_order(root: StepNode) -> List[StepNode]:
+    order: List[StepNode] = []
+    seen: set = set()
+
+    def visit(node: StepNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for up in node._upstream():
+            visit(up)
+        order.append(node)
+
+    visit(root)
+    return order
+
+
+def run(node: StepNode, *, workflow_id: str,
+        storage: Optional[str] = None) -> Any:
+    """Execute the workflow durably: each step runs as a cluster task, its
+    result persists before the next step starts, and a re-run with the same
+    workflow_id skips completed steps (reference: api.py:123 run +
+    workflow_state_from_storage.py resume)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    store = _Storage(workflow_id, storage)
+    order = _topo_order(node)
+    # Deterministic step ids: topological index + function name (stable for
+    # the same DAG shape across runs — the resume key).
+    ids = {id(n): f"{i:03d}_{n.name}" for i, n in enumerate(order)}
+    results: Dict[int, Any] = {}
+    for n in order:
+        sid = ids[id(n)]
+        if store.has(sid):
+            results[id(n)] = store.load(sid)
+            continue
+        args = tuple(
+            results[id(a)] if isinstance(a, StepNode) else a for a in n.args
+        )
+        kwargs = {
+            k: results[id(v)] if isinstance(v, StepNode) else v
+            for k, v in n.kwargs.items()
+        }
+        remote_fn = ray_tpu.remote(n.fn)
+        value = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+        store.save(sid, value)
+        results[id(n)] = value
+    return results[id(node)]
+
+
+class WorkflowRun:
+    def __init__(self, thread: threading.Thread, box: dict):
+        self._thread = thread
+        self._box = box
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("workflow still running")
+        if "error" in self._box:
+            raise self._box["error"]
+        return self._box["value"]
+
+
+def run_async(node: StepNode, *, workflow_id: str,
+              storage: Optional[str] = None) -> WorkflowRun:
+    """(reference: api.py:177 run_async)"""
+    box: dict = {}
+
+    def go():
+        try:
+            box["value"] = run(node, workflow_id=workflow_id, storage=storage)
+        except BaseException as e:  # noqa: BLE001 — delivered via result()
+            box["error"] = e
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    return WorkflowRun(t, box)
+
+
+def list_workflows(storage: Optional[str] = None) -> List[str]:
+    base = storage or DEFAULT_STORAGE
+    try:
+        return sorted(os.listdir(base))
+    except FileNotFoundError:
+        return []
+
+
+def delete(workflow_id: str, storage: Optional[str] = None):
+    import shutil
+
+    shutil.rmtree(os.path.join(storage or DEFAULT_STORAGE, workflow_id),
+                  ignore_errors=True)

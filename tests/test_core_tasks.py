@@ -201,3 +201,18 @@ def test_infeasible_task_does_not_block_others(rt):
 
     impossible.remote()
     assert rt.get(fine.remote(), timeout=30) == 1
+
+
+def test_runtime_env_working_dir(rt_shared, tmp_path):
+    """working_dir ships local files+modules to workers (reference:
+    _private/runtime_env/working_dir.py URI-cached packages)."""
+    (tmp_path / "helper_mod_wd.py").write_text("VALUE = 123\n")
+    (tmp_path / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def uses_wd():
+        import helper_mod_wd
+
+        return helper_mod_wd.VALUE, open("data.txt").read()
+
+    assert ray_tpu.get(uses_wd.remote()) == (123, "payload")

@@ -153,6 +153,39 @@ def _slow_fn(config):
     return {"value": config["i"], "training_iteration": 1}
 
 
+def _tuned_loop(config):
+    from ray_tpu import train
+
+    # "Training quality" depends on lr; report a deterministic loss.
+    loss = abs(config["lr"] - 0.1) + 0.01
+    train.report({"loss": loss})
+
+
+def test_tuner_over_trainer(rt, tmp_path):
+    """Tuner(trainer) sweeps train_loop_config (reference: BaseTrainer.fit
+    runs as a Tune trial; tuner accepts a trainer)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    trainer = JaxTrainer(
+        _tuned_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    results = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": tune.grid_search([0.01, 0.1, 0.5]),
+        }},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 3
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.config["train_loop_config"]["lr"] == 0.1
+    assert best.metrics["loss"] == pytest.approx(0.01)
+
+
 def test_tuner_interrupt_and_restore(rt, tmp_path):
     from ray_tpu.train import RunConfig
 
