@@ -361,18 +361,11 @@ def _pack_args(args: tuple, kwargs: dict):
     return None, arg_ids, oid.binary()
 
 
-def _process_runtime_env(renv: Optional[dict]) -> Optional[dict]:
-    """Upload runtime_env payloads once (content-addressed in the cluster
-    KV) and rewrite the env to reference them.  Supported: env_vars,
-    working_dir (reference: _private/runtime_env/working_dir.py — the dir is
-    packaged, cached by URI, and extracted on the worker)."""
-    if not renv or "working_dir" not in renv:
-        return renv
+def _package_working_dir(wd: str):
+    """Zip a working_dir into a content-addressed (key, blob) pair."""
     import io
     import zipfile
 
-    renv = dict(renv)
-    wd = renv.pop("working_dir")
     buf = io.BytesIO()
     n_files = 0
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -394,11 +387,30 @@ def _process_runtime_env(renv: Optional[dict]) -> Optional[dict]:
             "assets through the object store or shared storage instead"
         )
     key = f"wd:{hashlib.sha1(blob).hexdigest()}"
+    return key, blob
+
+
+def _process_runtime_env(renv: Optional[dict], cache: Optional[dict] = None):
+    """Upload runtime_env payloads (content-addressed in the cluster KV) and
+    rewrite the env to reference them.  `cache` memoizes the expensive zip
+    across calls, but the kv upload is re-ensured per client so a
+    shutdown()+init() cycle re-populates the new cluster's KV (reference:
+    _private/runtime_env/working_dir.py URI-cached packages)."""
+    if not renv or "working_dir" not in renv:
+        return renv
+    if cache is not None and "key" in cache:
+        key, blob = cache["key"], cache["blob"]
+    else:
+        key, blob = _package_working_dir(renv["working_dir"])
+        if cache is not None:
+            cache["key"], cache["blob"] = key, blob
     if key not in ctx.client.exported_keys:
         ctx.client.kv_put(key, blob, overwrite=False)
         ctx.client.exported_keys.add(key)
-    renv["working_dir_key"] = key
-    return renv
+    out = dict(renv)
+    out.pop("working_dir")
+    out["working_dir_key"] = key
+    return out
 
 
 _VALID_OPTIONS = {
@@ -439,12 +451,12 @@ class RemoteFunction:
 
     def _renv(self):
         # Options are immutable per instance: package the working_dir once,
-        # not once per .remote() (reference: URI-cached runtime-env packages).
+        # not once per .remote(); the KV upload re-ensures per cluster.
         if self._renv_cache is None:
-            self._renv_cache = _process_runtime_env(
-                self._options.get("runtime_env")
-            ) or {}
-        return self._renv_cache or None
+            self._renv_cache = {}
+        return _process_runtime_env(
+            self._options.get("runtime_env"), self._renv_cache
+        )
 
     def remote(self, *args, **kwargs):
         _ensure_init()
@@ -589,10 +601,10 @@ class ActorClass:
 
     def _renv(self):
         if self._renv_cache is None:
-            self._renv_cache = _process_runtime_env(
-                self._options.get("runtime_env")
-            ) or {}
-        return self._renv_cache or None
+            self._renv_cache = {}
+        return _process_runtime_env(
+            self._options.get("runtime_env"), self._renv_cache
+        )
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         _ensure_init()

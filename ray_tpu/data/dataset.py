@@ -67,6 +67,92 @@ def _part_agg(source: Source, ops: List[Op], col: str, kind: str):
 
 
 @ray_tpu.remote
+def _part_group_agg(source: Source, ops: List[Op], key: str,
+                    col: Optional[str], kind: str) -> dict:
+    """Per-block grouped partials: key -> (accumulator, count)."""
+    block = source() if callable(source) else source
+    for op in ops:
+        block = op(block)
+    if block.num_rows == 0:
+        return {}
+    cols = block.to_numpy()
+    keys = cols[key]
+    vals = cols[col] if col is not None else None
+    out: dict = {}
+    for i in builtins.range(len(keys)):
+        k = keys[i].item() if hasattr(keys[i], "item") else keys[i]
+        acc, cnt = out.get(k, (None, 0))
+        if vals is None:
+            out[k] = (None, cnt + 1)
+            continue
+        v = vals[i]
+        if acc is None:
+            acc = v
+        elif kind in ("sum", "mean"):
+            acc = acc + v
+        elif kind == "min":
+            acc = min(acc, v)
+        elif kind == "max":
+            acc = max(acc, v)
+        out[k] = (acc, cnt + 1)
+    return out
+
+
+class GroupedDataset:
+    """(reference: python/ray/data/grouped_data.py GroupedData)"""
+
+    def __init__(self, ds: "Dataset", key: str):
+        self._ds = ds
+        self._key = key
+
+    def _run(self, col: Optional[str], kind: str) -> "Dataset":
+        partials = ray_tpu.get([
+            _part_group_agg.remote(src, ops, self._key, col, kind)
+            for src, ops in self._ds._parts
+        ])
+        merged: dict = {}
+        for part in partials:
+            for k, (acc, cnt) in part.items():
+                macc, mcnt = merged.get(k, (None, 0))
+                if acc is None or macc is None:
+                    macc = acc if macc is None else macc
+                elif kind in ("sum", "mean"):
+                    macc = macc + acc
+                elif kind == "min":
+                    macc = min(macc, acc)
+                elif kind == "max":
+                    macc = max(macc, acc)
+                merged[k] = (macc, mcnt + cnt)
+        out_col = f"{kind}({col})" if col else "count()"
+        rows = []
+        for k in sorted(merged):
+            acc, cnt = merged[k]
+            if kind == "count":
+                val = cnt
+            elif kind == "mean":
+                val = acc / cnt if cnt else None
+            else:
+                val = acc
+            rows.append({self._key: k, out_col: val})
+        return from_items(rows)
+
+    def count(self) -> "Dataset":
+        return self._run(None, "count")
+
+    def sum(self, col: str) -> "Dataset":
+        return self._run(col, "sum")
+
+    def mean(self, col: str) -> "Dataset":
+        return self._run(col, "mean")
+
+    def min(self, col: str) -> "Dataset":
+        return self._run(col, "min")
+
+    def max(self, col: str) -> "Dataset":
+        return self._run(col, "max")
+
+
+@ray_tpu.remote
 def _gather_spans(spans: List[tuple]) -> Block:
     """Concatenate row spans [(block_ref, lo, hi), ...] into one block.
     Workers pull the referenced blocks (cross-node via the object plane)."""
@@ -446,6 +532,12 @@ class Dataset:
         total = sum(v for v, _ in partials)
         n = sum(c for _, c in partials)
         return total / n if n else None
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        """Group rows by a key column (reference: dataset.py groupby:1822 ->
+        grouped_data.py aggregations).  Per-block partial aggregates run as
+        tasks; the driver combines per key."""
+        return GroupedDataset(self, key)
 
     # ------------------------------------------------------------- splitting
 

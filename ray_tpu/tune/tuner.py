@@ -285,7 +285,9 @@ def _trainer_trainable(trainer) -> Callable:
         result = t.fit()
         if result.error is not None:
             raise result.error
-        return dict(result.metrics)
+        # Every round already reached the tune session via the callback;
+        # returning metrics again would duplicate the final report.
+        return None
 
     return fn
 

@@ -105,22 +105,53 @@ def run(node: StepNode, *, workflow_id: str,
     # the same DAG shape across runs — the resume key).
     ids = {id(n): f"{i:03d}_{n.name}" for i, n in enumerate(order)}
     results: Dict[int, Any] = {}
-    for n in order:
-        sid = ids[id(n)]
-        if store.has(sid):
-            results[id(n)] = store.load(sid)
+    remaining = [n for n in order]
+    inflight: Dict[Any, StepNode] = {}  # ref -> node
+    first_error: Optional[BaseException] = None
+    while remaining or inflight:
+        # Launch every step whose upstreams are resolved: independent
+        # branches run concurrently (reference: workflow_executor.py runs
+        # all ready tasks).
+        still_waiting: List[StepNode] = []
+        for n in remaining:
+            if first_error is not None:
+                still_waiting.append(n)
+                continue
+            sid = ids[id(n)]
+            if store.has(sid):
+                results[id(n)] = store.load(sid)
+                continue
+            if not all(id(u) in results for u in n._upstream()):
+                still_waiting.append(n)
+                continue
+            args = tuple(
+                results[id(a)] if isinstance(a, StepNode) else a
+                for a in n.args
+            )
+            kwargs = {
+                k: results[id(v)] if isinstance(v, StepNode) else v
+                for k, v in n.kwargs.items()
+            }
+            ref = ray_tpu.remote(n.fn).remote(*args, **kwargs)
+            inflight[ref] = n
+        remaining = still_waiting
+        if not inflight:
+            if first_error is not None:
+                raise first_error
             continue
-        args = tuple(
-            results[id(a)] if isinstance(a, StepNode) else a for a in n.args
-        )
-        kwargs = {
-            k: results[id(v)] if isinstance(v, StepNode) else v
-            for k, v in n.kwargs.items()
-        }
-        remote_fn = ray_tpu.remote(n.fn)
-        value = ray_tpu.get(remote_fn.remote(*args, **kwargs))
-        store.save(sid, value)
-        results[id(n)] = value
+        ready, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=3600)
+        for ref in ready:
+            n = inflight.pop(ref)
+            try:
+                value = ray_tpu.get(ref)
+            except BaseException as e:  # noqa: BLE001 — raised after drain
+                if first_error is None:
+                    first_error = e
+                continue
+            store.save(ids[id(n)], value)
+            results[id(n)] = value
+    if first_error is not None:
+        raise first_error
     return results[id(node)]
 
 

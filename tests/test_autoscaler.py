@@ -1,0 +1,56 @@
+"""Autoscaler tests: scale up on demand, scale down when idle.
+
+Reference analog: python/ray/tests/test_autoscaler_fake_multinode.py —
+the fake provider launches real node processes in-place.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    # A 1-CPU head: any parallel workload has unmet demand immediately.
+    ray_tpu.init(num_cpus=1)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_scale_up_then_down(rt):
+    provider = LocalNodeProvider(num_cpus=2)
+    scaler = Autoscaler(provider, min_nodes=0, max_nodes=2,
+                        idle_timeout_s=3.0, poll_interval_s=0.5)
+    scaler.start()
+    try:
+        @ray_tpu.remote
+        def work(i):
+            time.sleep(1.0)
+            return i
+
+        refs = [work.remote(i) for i in range(6)]
+        # Demand forces scale-up beyond the 1-CPU head.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(provider.non_terminated_nodes()) >= 1:
+                break
+            time.sleep(0.2)
+        assert len(provider.non_terminated_nodes()) >= 1
+        assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(6))
+
+        # Idle nodes drain after the timeout.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(provider.non_terminated_nodes()) == 0:
+                break
+            time.sleep(0.5)
+        assert len(provider.non_terminated_nodes()) == 0
+    finally:
+        scaler.stop()
+        for h in provider.non_terminated_nodes():
+            provider.terminate_node(h)

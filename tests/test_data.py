@@ -235,3 +235,16 @@ def test_streaming_split_multiple_epochs(rt):
             [b["id"] for b in it.iter_batches(batch_size=10)]
         )
         assert sorted(ids.tolist()) == list(range(40))
+
+
+def test_groupby_aggregations(rt_shared):
+    ds = rtd.from_items([
+        {"k": i % 3, "v": float(i)} for i in range(12)
+    ]).repartition(4)
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[0] == (0 + 3 + 6 + 9) / 4
+    assert {r["k"]: r["max(v)"] for r in ds.groupby("k").max("v").take_all()}[2] == 11.0

@@ -80,3 +80,26 @@ def test_workflow_run_async(rt, tmp_path):
     node = workflow.step(slow)(7)
     run = workflow.run_async(node, workflow_id="async", storage=str(tmp_path))
     assert run.result(timeout=60) == 21
+
+
+def test_workflow_parallel_branches(rt, tmp_path):
+    """Independent branches run concurrently (reference: the executor runs
+    all ready steps, workflow_executor.py)."""
+    import time as _t
+
+    def slow_shard(i):
+        import time
+
+        time.sleep(0.8)
+        return i
+
+    def merge(*parts):
+        return sum(parts)
+
+    shards = [workflow.step(slow_shard)(i) for i in range(4)]
+    node = workflow.step(merge)(*shards)
+    t0 = _t.time()
+    out = workflow.run(node, workflow_id="par", storage=str(tmp_path))
+    wall = _t.time() - t0
+    assert out == 6
+    assert wall < 2.5, f"branches serialized: {wall:.1f}s for 4x0.8s steps"
