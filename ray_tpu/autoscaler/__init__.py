@@ -16,11 +16,14 @@ elasticity and tests).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
 
 import ray_tpu
+
+logger = logging.getLogger(__name__)
 
 
 class NodeProvider:
@@ -44,17 +47,14 @@ class LocalNodeProvider(NodeProvider):
 
     def __init__(self, num_cpus: int = 2,
                  resources: Optional[Dict[str, float]] = None):
+        import os
+
         from ..cluster_utils import Cluster
 
         self.num_cpus = num_cpus
         self.resources = resources
         self._nodes: List[object] = []
-        self._cluster = Cluster.__new__(Cluster)  # reuse spawn machinery
-        self._cluster.nodes = []
-        self._cluster._sessions = []
-        import os
-
-        self._cluster.head_addr = os.environ["RT_ADDRESS"]
+        self._cluster = Cluster.attach(os.environ["RT_ADDRESS"])
 
     def create_node(self):
         handle = self._cluster.add_node(
@@ -67,7 +67,8 @@ class LocalNodeProvider(NodeProvider):
         try:
             self._cluster.remove_node(handle, graceful=True)
         except Exception:
-            pass
+            logger.exception("terminate_node failed; keeping handle")
+            return
         if handle in self._nodes:
             self._nodes.remove(handle)
 
@@ -103,33 +104,41 @@ class Autoscaler:
 
     # -- observation ---------------------------------------------------------
 
-    def _demand(self) -> int:
-        """Unmet demand: queued/pending tasks beyond what current free
-        resources can host, plus pending placement groups (reference:
-        load_metrics.py resource demand vectors, simplified to task count)."""
+    def _snapshot(self) -> dict:
+        """One state fetch per tick (head message processing is the
+        control-plane bound; don't poll per node)."""
         from ray_tpu.core.context import ctx
 
-        tasks = ctx.client.call("list_state", {"kind": "tasks"})["items"]
-        pending = sum(1 for t in tasks if t.get("state") == "PENDING")
-        pgs = ctx.client.call("list_state",
-                              {"kind": "placement_groups"})["items"]
-        pending_pgs = sum(1 for p in pgs if not p.get("created"))
+        return {
+            kind: ctx.client.call("list_state", {"kind": kind})["items"]
+            for kind in ("tasks", "placement_groups", "nodes", "workers")
+        }
+
+    @staticmethod
+    def _demand(snap: dict) -> int:
+        """Unmet demand: runnable pending tasks (dep-blocked ones can't use
+        a new node) plus pending placement groups (reference:
+        load_metrics.py resource demand vectors, simplified to counts)."""
+        pending = sum(
+            1 for t in snap["tasks"]
+            if t.get("state") == "PENDING" and not t.get("dep_blocked")
+        )
+        pending_pgs = sum(
+            1 for p in snap["placement_groups"] if not p.get("created")
+        )
         return pending + pending_pgs
 
-    def _node_busy(self, node_hex: str) -> bool:
-        from ray_tpu.core.context import ctx
-
-        nodes = ctx.client.call("list_state", {"kind": "nodes"})["items"]
-        for n in nodes:
+    @staticmethod
+    def _node_busy(snap: dict, node_hex: str) -> bool:
+        for n in snap["nodes"]:
             if n["node_id"] == node_hex:
                 total = n.get("resources", {})
                 avail = n.get("available", {})
                 if any(avail.get(k, 0) < v for k, v in total.items()):
                     return True
-        workers = ctx.client.call("list_state", {"kind": "workers"})["items"]
         return any(
             w["node_id"] == node_hex and w["state"] in ("leased", "actor")
-            for w in workers
+            for w in snap["workers"]
         )
 
     # -- reconcile -----------------------------------------------------------
@@ -138,18 +147,22 @@ class Autoscaler:
         """One reconcile step: scale up on unmet demand, scale down idle
         nodes past the timeout."""
         nodes = self.provider.non_terminated_nodes()
-        demand = self._demand()
-        if demand > 0 and len(nodes) < self.max_nodes:
-            for _ in range(min(self.upscaling_speed,
-                               self.max_nodes - len(nodes))):
-                self.provider.create_node()
+        snap = self._snapshot()
+        demand = self._demand(snap)
+        if demand > 0:
+            # Never drain while demand exists — at max_nodes that would
+            # churn create/terminate forever.
+            if len(nodes) < self.max_nodes:
+                for _ in range(min(self.upscaling_speed,
+                                   self.max_nodes - len(nodes))):
+                    self.provider.create_node()
             return
         now = time.monotonic()
         for handle in nodes:
             if len(self.provider.non_terminated_nodes()) <= self.min_nodes:
                 break
             hex_id = self.provider.node_id_of(handle)
-            if self._node_busy(hex_id):
+            if self._node_busy(snap, hex_id):
                 self._idle_since.pop(hex_id, None)
                 continue
             first_idle = self._idle_since.setdefault(hex_id, now)
@@ -170,7 +183,7 @@ class Autoscaler:
                 try:
                     self.update()
                 except Exception:
-                    pass
+                    logger.exception("autoscaler update failed")
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="autoscaler")

@@ -59,6 +59,20 @@ class Cluster:
         # shutdown so crash-simulation tests don't leak segments.
         self._sessions: List[str] = []
 
+    @classmethod
+    def attach(cls, head_addr: str) -> "Cluster":
+        """Attach to an already-initialized cluster (no head startup):
+        add_node/remove_node then manage daemons against it — used by the
+        autoscaler's LocalNodeProvider."""
+        self = cls.__new__(cls)
+        self.head_addr = head_addr
+        from ray_tpu.core.context import ctx
+
+        self.head_node_id = ctx.client.node_id if ctx.client else None
+        self.nodes = []
+        self._sessions = []
+        return self
+
     def add_node(
         self,
         num_cpus: int = 2,

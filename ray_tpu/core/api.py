@@ -488,10 +488,10 @@ class RemoteFunction:
             "retry_exceptions": bool(o.get("retry_exceptions", False)),
             "runtime_env": self._renv(),
         }
-        # Submission is pipelined: the ref is returned immediately and the
-        # spec rides the ordered connection (reference: task submission is
-        # async; errors surface on ray.get of the returned ref).
-        ctx.client.call_bg("submit_task", spec)
+        # Submission is pipelined AND batched: the ref returns immediately
+        # and bursts coalesce into one head RPC (reference: task submission
+        # is async; errors surface on ray.get of the returned ref).
+        ctx.client.call_batched("submit_task", spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(r) for r in return_ids]
@@ -566,7 +566,7 @@ class ActorHandle:
             "return_ids": [r.binary() for r in return_ids],
             "max_retries": self._max_task_retries,
         }
-        ctx.client.call_bg("submit_actor_task", spec)
+        ctx.client.call_batched("submit_actor_task", spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(r) for r in return_ids]

@@ -70,6 +70,9 @@ class Client:
         # any other outbound call — see _flush_put_batch).
         self._put_batch: List[dict] = []
         self._put_batch_lock = threading.Lock()
+        # Buffered fire-and-forget calls (see call_batched).
+        self._submit_batch: List[dict] = []
+        self._submit_batch_lock = threading.Lock()
         # Function-table keys this process has already exported (api._export).
         self.exported_keys: set = set()
         # Object ids of large (shm) objects this process put: their frees
@@ -105,6 +108,10 @@ class Client:
                 return  # superseded by a newer session's client
             try:
                 oref._flush_free_queue(background=True)
+                # Safety net: batched calls must not sit forever in a driver
+                # that stops making client calls (e.g. waits on side effects).
+                self._flush_submit_batch()
+                self._flush_put_batch()
             except Exception:
                 pass
 
@@ -173,6 +180,7 @@ class Client:
         surface on the next synchronous call; a bounded in-flight window
         applies backpressure when the head falls behind."""
         self._flush_put_batch()
+        self._flush_submit_batch()
         self._call_bg_raw(method, body)
 
     def _call_bg_raw(self, method: str, body: Any):
@@ -191,6 +199,24 @@ class Client:
             batch, self._put_batch = self._put_batch, []
         if batch:
             self._call_bg_raw("put_object_batch", {"objects": batch})
+
+    def call_batched(self, method: str, body: dict):
+        """Buffer a fire-and-forget call; bursts flush as ONE head RPC
+        (head message processing, not wire latency, bounds control-plane
+        throughput).  Order within the mixed batch is preserved, and every
+        sync/bg call flushes it first, so batching never reorders."""
+        self._flush_put_batch()  # registrations precede referencing bodies
+        with self._submit_batch_lock:
+            self._submit_batch.append({"method": method, "body": body})
+            n = len(self._submit_batch)
+        if n >= 64:
+            self._flush_submit_batch()
+
+    def _flush_submit_batch(self):
+        with self._submit_batch_lock:
+            batch, self._submit_batch = self._submit_batch, []
+        if batch:
+            self._call_bg_raw("batch", {"entries": batch})
 
     def _note_bg_exc(self, fut, wait: bool = False):
         try:
@@ -213,6 +239,7 @@ class Client:
     def drain_bg(self, timeout: float = 30.0):
         """Block until all fired background RPCs have been acknowledged."""
         self._flush_put_batch()
+        self._flush_submit_batch()
         with self._bg_lock:
             futs, self._bg_futs = list(self._bg_futs), deque()
         for f in futs:
@@ -292,6 +319,7 @@ class Client:
     def get_raw(self, object_ids: Sequence[ObjectID], timeout: float = -1.0):
         """Fetch wire descriptors for objects (blocking until sealed)."""
         self._flush_put_batch()
+        self._flush_submit_batch()
         with self._maybe_blocked():
             reply = self.rpc.call(
                 "get_objects",
@@ -557,6 +585,7 @@ class Client:
 
     def wait(self, refs: Sequence, num_returns: int, timeout: float):
         self._flush_put_batch()
+        self._flush_submit_batch()
         with self._maybe_blocked():
             reply = self.rpc.call(
                 "wait_objects",
@@ -578,10 +607,11 @@ class Client:
             if raw in self.large_oids:
                 self._last_large_free = time.monotonic()
             self.large_oids.discard(raw)
-        # Flush buffered registrations first: freeing an object whose
-        # registration is still batched would hit an unknown record head-side
-        # and the late registration would then resurrect it as a leak.
+        # Flush buffered registrations/submissions first: freeing an object
+        # whose registration is still batched would hit an unknown record
+        # head-side and the late registration would resurrect it as a leak.
         self._flush_put_batch()
+        self._flush_submit_batch()
         self.rpc.call("free_objects", {"object_ids": raw_ids})
 
     def add_reference(self, raw_id: bytes):
@@ -639,6 +669,7 @@ class Client:
     def call(self, method: str, body=None, timeout: float = 60.0):
         self.check_bg()
         self._flush_put_batch()
+        self._flush_submit_batch()
         return self.rpc.call(method, body, timeout=timeout)
 
     def close(self):

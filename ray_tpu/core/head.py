@@ -238,7 +238,7 @@ class Head:
         for name in [
             "register", "kv_put", "kv_get", "kv_del", "kv_keys",
             "submit_task", "create_actor", "submit_actor_task",
-            "task_done", "stream_item", "metrics_report",
+            "task_done", "stream_item", "metrics_report", "batch",
             "put_object", "put_object_batch",
             "get_objects",
             "wait_objects", "free_objects", "object_free_ack",
@@ -795,6 +795,26 @@ class Head:
                 await self.h_create_actor(None, spec)
             except Exception:
                 pass
+
+    async def h_batch(self, conn, body):
+        """Mixed fire-and-forget batch: one RPC carries many submissions /
+        task_done reports (clients batch bursts; per-message head processing
+        is the control-plane throughput bound)."""
+        for entry in body["entries"]:
+            fn = self.server.handlers.get(entry["method"])
+            if fn is None:
+                continue
+            try:
+                result = fn(conn, entry["body"])
+                if asyncio.iscoroutine(result):
+                    await result
+            except Exception:
+                # Per-entry isolation: one bad spec must not drop the rest
+                # of the batch (their callers would block forever).
+                import traceback
+
+                traceback.print_exc()
+        return {}
 
     async def h_metrics_report(self, conn, body):
         """Per-process metric snapshots; the head keeps the latest rows per
@@ -2110,6 +2130,7 @@ class Head:
                     "task_id": t.task_id.hex(),
                     "name": t.spec.get("name", ""),
                     "state": t.state,
+                    "dep_blocked": bool(t.pending_deps),
                     "start_time": t.start_time,
                     "end_time": t.end_time,
                     "error": t.error,

@@ -245,10 +245,14 @@ class Worker:
                 {"object_id": raw} for raw in spec.get("return_ids", [])
             ]
         try:
-            # Pipelined: the worker moves to its next queued task without
-            # waiting a round trip (reference: PushTask replies carry results
-            # asynchronously).  Connection loss exits via on_connection_lost.
-            self.client.call_bg("task_done", body)
+            # Pipelined + batched: the worker moves on without a round trip,
+            # and a burst of completions coalesces into one head RPC; the
+            # run loop flushes when its queue drains (reference: PushTask
+            # replies carry results asynchronously).
+            self.client.call_batched("task_done", body)
+            if self.task_queue.empty():
+                # No follow-up work: the caller is blocking on this result.
+                self.client._flush_submit_batch()
             if _DEBUG_PUSH:
                 print(f"DONE-SENT {spec.get('name')} "
                       f"{spec['task_id'].hex()[:8]}", file=sys.stderr,
@@ -454,6 +458,9 @@ class Worker:
             try:
                 spec = self.task_queue.get(timeout=0.1)
             except queue.Empty:
+                # Idle: completed-task reports must not sit in the batch
+                # (their callers block until the head processes them).
+                self.client._flush_submit_batch()
                 continue
             is_method = bool(spec.get("method_name"))
             fn = getattr(self.actor_instance, spec["method_name"], None) \

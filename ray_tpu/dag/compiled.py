@@ -100,6 +100,11 @@ class CompiledDAG:
             )
             for i, step in enumerate(self._steps)
         ]
+        # The DAG synchronizes over shm channels, never the control plane:
+        # batched submissions must flush now or the exec loops never start.
+        from ..core.context import ctx
+
+        ctx.client._flush_submit_batch()
         self._lock = threading.Lock()
         self._torn_down = False
 
