@@ -606,6 +606,17 @@ class Head:
             conn.meta["node_id"] = node_id
             self._kick()
             return {"session": self.session, "node_id": node_id.binary()}
+        # Drivers attach the HEAD node's shm session for zero-copy reads: a
+        # driver on another machine would mmap the wrong (or no) store, so
+        # reject it explicitly instead of corrupting location preferences
+        # (remote entrypoints go through job_submission / a cluster node).
+        peer = conn.writer.get_extra_info("peername")
+        if peer and peer[0] not in ("127.0.0.1", "::1", self.host):
+            raise ValueError(
+                f"driver connections must originate on the head host "
+                f"(got {peer[0]}); submit remote work via "
+                "ray_tpu.job_submission or run the driver on a cluster node"
+            )
         conn.meta["kind"] = kind  # driver
         conn.meta["pid"] = body.get("pid")
         conn.meta["reader_node"] = self.local_node_id
