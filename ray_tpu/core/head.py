@@ -611,7 +611,10 @@ class Head:
         # reject it explicitly instead of corrupting location preferences
         # (remote entrypoints go through job_submission / a cluster node).
         peer = conn.writer.get_extra_info("peername")
-        if peer and peer[0] not in ("127.0.0.1", "::1", self.host):
+        peer_ip = peer[0] if peer else ""
+        if peer_ip.startswith("::ffff:"):  # IPv4-mapped (dual-stack socket)
+            peer_ip = peer_ip[len("::ffff:"):]
+        if peer_ip and peer_ip not in ("127.0.0.1", "::1", self.host):
             raise ValueError(
                 f"driver connections must originate on the head host "
                 f"(got {peer[0]}); submit remote work via "
