@@ -446,6 +446,13 @@ class Client:
             return existing
         size = desc["size"]
         buf, commit, abort = local.create_staged(oid, size)
+        if size >= (8 << 20):
+            # Fault in backing pages in parallel before the transfer: the
+            # recv_into loop otherwise pays first-touch faults serially,
+            # one page per 4 KiB of stream.
+            from ray_tpu import _native
+
+            _native.prefault(buf)
         bulk_addr = desc.get("bulk_addr")
         if bulk_addr:
             try:
@@ -461,6 +468,8 @@ class Client:
             # one connection so the transfer overlaps server read, wire time
             # and local memcpy (reference: object_manager.h:63 splits objects
             # into chunks and streams them concurrently).
+            from ray_tpu import _native
+
             rpc = self._pull_conn(addr)
             window = 8
             futs: Dict[int, Any] = {}
@@ -490,7 +499,10 @@ class Client:
                     raise exceptions.ObjectLostError(
                         f"object {oid}: short chunk at offset {off} from {addr}"
                     )
-                buf[off:off + len(data)] = data
+                if len(data) >= (1 << 20):
+                    _native.copy(buf[off:off + len(data)], data)
+                else:
+                    buf[off:off + len(data)] = data
                 fire()
         except Exception:
             abort()

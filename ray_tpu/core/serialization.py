@@ -87,7 +87,10 @@ def packed_size(meta: bytes, buffers: List[pickle.PickleBuffer]) -> int:
 
 def pack_into(meta: bytes, buffers: List[pickle.PickleBuffer], dest: memoryview) -> int:
     """Write the packed representation directly into ``dest`` (e.g. a shm
-    segment), returning bytes written.  This is the zero-extra-copy write path."""
+    segment), returning bytes written.  This is the zero-extra-copy write path;
+    large buffers go through the native parallel memcpy (GIL released)."""
+    from ray_tpu import _native
+
     off = 0
     dest[off : off + 4] = struct.pack("<I", len(meta))
     off += 4
@@ -100,7 +103,11 @@ def pack_into(meta: bytes, buffers: List[pickle.PickleBuffer], dest: memoryview)
         n = raw.nbytes
         dest[off : off + 8] = struct.pack("<Q", n)
         off += 8
-        dest[off : off + n] = raw.cast("B") if raw.format != "B" else raw
+        src = raw.cast("B") if raw.format != "B" else raw
+        if n >= (1 << 20):
+            _native.copy(dest[off : off + n], src)
+        else:
+            dest[off : off + n] = src
         off += n
     return off
 

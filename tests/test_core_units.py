@@ -182,3 +182,75 @@ class TestScheduler:
         s, (n1, n2) = _mk_sched({"CPU": 2}, {"CPU": 2})
         s.remove_node(n1)
         assert s.pick_node({"CPU": 2}) == n2
+
+
+class TestNativeFastpath:
+    """The native parallel-memcpy extension (ray_tpu/_native) and its
+    integration into the packed-object write path."""
+
+    def test_copy_roundtrip(self):
+        import numpy as np
+
+        from ray_tpu import _native
+
+        src = np.random.default_rng(0).integers(0, 256, 4 << 20, dtype=np.uint8)
+        dst = bytearray(len(src))
+        n = _native.copy(dst, src)
+        assert n == len(src)
+        assert bytes(dst) == src.tobytes()
+
+    def test_copy_forced_multithread(self):
+        import numpy as np
+
+        from ray_tpu import _native
+
+        src = np.arange(3 << 20, dtype=np.uint8)  # odd size, forces tail span
+        dst = bytearray(len(src))
+        _native.copy(dst, src, 7)
+        assert bytes(dst) == src.tobytes()
+
+    def test_copy_covers_tail_at_aligned_floor(self):
+        """Regression: n = k*aligned_floor + 1 must not drop the tail byte
+        (floor-divide chunking covered only k*chunk bytes)."""
+        import numpy as np
+
+        from ray_tpu import _native
+
+        for n, k in [(16385, 2), ((8 << 20) + 1, 2), (64 * 3 + 1, 3)]:
+            src = np.random.default_rng(n).integers(0, 256, n, dtype=np.uint8)
+            dst = bytearray(n)
+            assert _native.copy(dst, src, k) == n
+            assert bytes(dst) == src.tobytes(), (n, k)
+
+    def test_copy_rejects_oversized_source(self):
+        from ray_tpu import _native
+
+        if not _native.available:
+            import pytest
+
+            pytest.skip("native extension unavailable; fallback slices differently")
+        import pytest
+
+        with pytest.raises(ValueError):
+            _native.copy(bytearray(4), b"12345")
+
+    def test_prefault(self):
+        from ray_tpu import _native
+
+        buf = bytearray(1 << 20)
+        _native.prefault(buf)
+        assert bytes(buf[:8]) == b"\x00" * 8
+
+    def test_pack_into_large_buffer_uses_native_path(self):
+        import numpy as np
+
+        from ray_tpu.core import serialization
+
+        arr = np.random.default_rng(1).standard_normal(1 << 18)  # 2 MiB
+        meta, bufs = serialization.serialize(arr)
+        size = serialization.packed_size(meta, bufs)
+        out = bytearray(size)
+        written = serialization.pack_into(meta, bufs, memoryview(out))
+        assert written == size
+        back = serialization.unpack(memoryview(out))
+        assert np.array_equal(back, arr)
