@@ -1,0 +1,207 @@
+"""TPU accelerator support: chip autodetect, visibility isolation, pod-slice
+resources.
+
+Role-equivalent to the reference's pluggable accelerator managers
+(reference: python/ray/_private/accelerators/accelerator.py,
+tpu.py:71 TPUAcceleratorManager) — re-designed for this framework:
+
+- **Autodetect** (`num_chips`): counts ``/dev/accel*`` then ``/dev/vfio/<n>``
+  device files (reference: tpu.py:97-117).  ``RT_TPU_CHIPS`` overrides for
+  tests and for operators who want to advertise fewer chips than the host has.
+- **Pod-slice resources** (`node_resources`): a host that knows its pod type
+  (``TPU_ACCELERATOR_TYPE`` env, GKE-style) advertises ``TPU-<version>``
+  (e.g. ``TPU-V5E``) alongside the ``TPU`` chip count, and worker 0 of a pod
+  advertises the ``TPU-<pod_type>-head`` marker resource so exactly one
+  framework task can claim slice leadership (reference: tpu.py:198-314).
+  GCE metadata-server probing is gated behind ``RT_TPU_GCE_METADATA=1``
+  because this build targets zero-egress environments.
+- **Visibility isolation** (`visibility_env`): a task that requests
+  ``{"TPU": n}`` with n < host chips gets ``TPU_VISIBLE_CHIPS`` plus the
+  chip/host-bounds variables that make libtpu carve out a sub-host topology
+  (reference: tpu.py:155-196; the 1-chip and 2-chip bounds come from the
+  jax#14977 recipe).  n == all chips clears the bounds so JAX uses the
+  host defaults.
+
+The head's scheduler owns the per-node chip-ID pool (scheduler.py
+``allocate_tpu_chips``); the worker applies the env right before running the
+task's function, i.e. before user code first imports jax.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, List, Optional
+
+TPU_VALID_CHIP_OPTIONS = (1, 2, 4, 8)
+
+#: Versions whose devices expose 2 cores per chip (affects pod host math).
+_MULTI_CORE_VERSIONS = {"v2", "v3", "v4"}
+
+_POD_TYPE_RE = re.compile(r"^v\d+[a-zA-Z]*-\d+$")
+
+
+def num_chips() -> int:
+    """Number of TPU chips attached to this host (0 when none)."""
+    override = os.environ.get("RT_TPU_CHIPS")
+    if override is not None:
+        try:
+            return max(0, int(override))
+        except ValueError:
+            return 0
+    n = len(glob.glob("/dev/accel*"))
+    if n:
+        return n
+    try:
+        return sum(1 for e in os.listdir("/dev/vfio") if e.isdigit())
+    except (FileNotFoundError, NotADirectoryError, PermissionError):
+        return 0
+
+
+def is_valid_pod_type(pod_type: str) -> bool:
+    """``v<generation>-<chips_or_cores>``, e.g. ``v5e-8`` / ``v4-16``."""
+    return bool(_POD_TYPE_RE.match(pod_type))
+
+
+def pod_type() -> Optional[str]:
+    """The pod/slice type this host belongs to, if known."""
+    t = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if not t and os.environ.get("RT_TPU_GCE_METADATA") == "1":
+        t = _gce_metadata("accelerator-type") or ""
+    return t if t and is_valid_pod_type(t) else None
+
+
+def tpu_name() -> Optional[str]:
+    name = os.environ.get("TPU_NAME")
+    if not name and os.environ.get("RT_TPU_GCE_METADATA") == "1":
+        name = _gce_metadata("instance-id")
+    return name or None
+
+
+def worker_id() -> Optional[int]:
+    wid = os.environ.get("TPU_WORKER_ID")
+    if not wid and os.environ.get("RT_TPU_GCE_METADATA") == "1":
+        wid = _gce_metadata("agent-worker-number")
+    try:
+        return int(wid) if wid else None
+    except ValueError:
+        return None
+
+
+def _gce_metadata(key: str) -> Optional[str]:
+    """GCE VM metadata (requires network egress — opt-in only)."""
+    import urllib.request
+
+    url = f"http://metadata.google.internal/computeMetadata/v1/instance/attributes/{key}"
+    try:
+        req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            if resp.status == 200:
+                return resp.read().decode()
+    except Exception:
+        pass
+    return None
+
+
+def pod_worker_count(pod: str) -> int:
+    """Hosts in a slice of the given pod type (v2-v4 count cores, 8/host;
+    later generations count chips, 4/host)."""
+    version, _, count = pod.partition("-")
+    per_host = 8 if version in _MULTI_CORE_VERSIONS else 4
+    return max(1, int(count) // per_host)
+
+
+def accelerator_type(pod: Optional[str] = None) -> Optional[str]:
+    """Version marker resource, e.g. ``TPU-V5E`` (reference: tpu.py:296)."""
+    pod = pod or pod_type()
+    if not pod:
+        return None
+    return "TPU-" + pod.split("-")[0].upper()
+
+
+def validate_request(quantity: float) -> Optional[str]:
+    """None when ``quantity`` is a supported per-task chip count, else an
+    error message.  Fractional requests time-share one chip and are allowed."""
+    if 0 < quantity < 1:
+        return None
+    if quantity in TPU_VALID_CHIP_OPTIONS:
+        return None
+    return (
+        f"requested TPU={quantity}, but only {TPU_VALID_CHIP_OPTIONS} (or a "
+        "fraction < 1) map to valid per-host chip topologies"
+    )
+
+
+def node_resources() -> Dict[str, float]:
+    """Resources a node daemon should auto-advertise for its TPUs."""
+    n = num_chips()
+    if n == 0:
+        return {}
+    res: Dict[str, float] = {"TPU": float(n)}
+    pod = pod_type()
+    acc = accelerator_type(pod)
+    if acc:
+        res[acc] = float(n)
+    if pod and (worker_id() or 0) == 0:
+        res[f"TPU-{pod}-head"] = 1.0
+    return res
+
+
+def node_labels() -> Dict[str, str]:
+    """Topology labels for affinity scheduling (slice name + host index)."""
+    labels: Dict[str, str] = {}
+    pod = pod_type()
+    if pod:
+        labels["tpu-pod-type"] = pod
+    name = tpu_name()
+    if name:
+        labels["tpu-name"] = name
+    wid = worker_id()
+    if wid is not None:
+        labels["tpu-worker-id"] = str(wid)
+    return labels
+
+
+def visibility_env(chip_ids: List[int], host_chips: Optional[int] = None) -> Dict[str, str]:
+    """Env vars granting a process exactly ``chip_ids``.
+
+    Empty-string values mean "unset this variable" (the worker applies them
+    with ``os.environ.pop``).  Granting every chip on the host clears the
+    sub-host bounds so libtpu uses its defaults.
+    """
+    if host_chips is None:
+        host_chips = num_chips()
+    n = len(chip_ids)
+    if n == 0 or n == host_chips:
+        return {
+            "TPU_VISIBLE_CHIPS": "",
+            "TPU_CHIPS_PER_HOST_BOUNDS": "",
+            "TPU_HOST_BOUNDS": "",
+        }
+    env = {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in sorted(chip_ids))}
+    if n == 1:
+        env["TPU_CHIPS_PER_HOST_BOUNDS"] = "1,1,1"
+        env["TPU_HOST_BOUNDS"] = "1,1,1"
+    elif n == 2:
+        env["TPU_CHIPS_PER_HOST_BOUNDS"] = "1,2,1"
+        env["TPU_HOST_BOUNDS"] = "1,1,1"
+    # 4-chip grants on an 8-chip host inherit default bounds: there is no
+    # single sub-host topology that covers both v5e (2x4) and v6e layouts,
+    # so only TPU_VISIBLE_CHIPS narrows the view.
+    return env
+
+
+def apply_visibility(chip_ids: List[int], host_chips: Optional[int] = None) -> None:
+    """Apply `visibility_env` to this process.  Must run before the first
+    ``import jax`` to take effect (reference applies the same env dance at
+    task start: tpu.py:155 set_current_process_visible_accelerator_ids)."""
+    for k, v in visibility_env(chip_ids, host_chips).items():
+        if v == "":
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    if chip_ids:
+        # The worker was spawned with JAX_PLATFORMS=cpu so it could not steal
+        # the host's chips; a task granted chips flips back to TPU.
+        os.environ["JAX_PLATFORMS"] = "tpu,cpu"

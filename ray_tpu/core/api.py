@@ -100,6 +100,8 @@ def _strategy_wire(strategy) -> Optional[dict]:
 
 
 def _detect_resources(num_cpus=None, num_tpus=None, resources=None) -> Dict[str, float]:
+    from ray_tpu import accelerators
+
     out: Dict[str, float] = dict(resources or {})
     out["CPU"] = float(num_cpus if num_cpus is not None else os.cpu_count() or 1)
     if num_tpus is None:
@@ -112,6 +114,10 @@ def _detect_resources(num_cpus=None, num_tpus=None, resources=None) -> Dict[str,
         accel = os.environ.get("RT_TPU_ACCELERATOR_TYPE")
         if accel:
             out[f"TPU-{accel}-head"] = 1.0
+    elif "TPU" not in out:
+        # Autodetect chips from /dev (reference: tpu.py:97-117 counts
+        # /dev/accel* at node start); explicit num_tpus/resources win.
+        out.update(accelerators.node_resources())
     out.setdefault("memory", float(2**33))
     return out
 
@@ -128,6 +134,8 @@ def init(
     system_config: Optional[dict] = None,
     labels: Optional[Dict[str, str]] = None,
     ignore_reinit_error: bool = False,
+    include_dashboard: bool = False,
+    dashboard_port: int = 0,
 ):
     """Start (or connect to) a cluster.  With no address, an in-process control
     plane is started and worker processes are spawned on demand."""
@@ -183,6 +191,10 @@ def init(
         ctx.mode = "driver"
         ctx.session = ctx.client.session
         ctx.namespace = namespace
+        if include_dashboard:
+            from ray_tpu.dashboard import Dashboard
+
+            ctx.dashboard = Dashboard(address, port=dashboard_port).start()
         if os.environ.get("RT_LOG_TO_DRIVER", "1") != "0":
             # Worker stdout/stderr arrive over pubsub (reference: the log
             # monitor republishes worker logs to the driver).
@@ -229,6 +241,11 @@ def shutdown():
             return
         head_proc = ctx.head_process
         client = ctx.client
+        if ctx.dashboard is not None:
+            try:
+                ctx.dashboard.stop()
+            except Exception:
+                pass
         # Flush pending ObjectRef frees so a long-lived driver doesn't leave
         # up to a batch of shm segments behind.
         from .object_ref import _flush_free_queue
@@ -426,6 +443,14 @@ def _resources_from_options(o: dict, default_cpu: float = 1.0) -> Dict[str, floa
     res["CPU"] = float(o["num_cpus"]) if o.get("num_cpus") is not None else default_cpu
     if o.get("num_tpus"):
         res["TPU"] = float(o["num_tpus"])
+    if res.get("TPU"):
+        # Whole-chip requests must map to a valid sub-host topology
+        # (reference: tpu.py:141 validate_resource_request_quantity).
+        from ray_tpu import accelerators
+
+        err = accelerators.validate_request(res["TPU"])
+        if err is not None:
+            raise ValueError(err)
     if o.get("memory"):
         res["memory"] = float(o["memory"])
     return {k: v for k, v in res.items() if v}

@@ -37,6 +37,9 @@ from .scheduler import ClusterScheduler, SchedulingStrategy
 # Worker / actor / task states (subset of the reference FSMs:
 # gcs_actor_manager.h actor FSM, worker_pool.h worker states).
 STARTING, IDLE, LEASED, ACTOR, DEAD = "starting", "idle", "leased", "actor", "dead"
+# A worker that ran a TPU-chip-granted task: told to exit, never re-picked
+# (the process keeps the chips mapped until it dies).
+RETIRING = "retiring"
 # BLOCKED: leased worker parked in a nested get/wait; its task's resources
 # are released so the pool can run other work (see h_task_blocked).
 BLOCKED = "blocked"
@@ -69,6 +72,14 @@ class WorkerState:
         self.actor_id: Optional[ActorID] = None
         self.last_seen = time.monotonic()  # last dispatch/completion activity
         self.last_ack = time.monotonic()   # last health-check ack
+        # TPU chip IDs this worker process has been granted.  jax/libtpu
+        # keep the devices mapped until process exit, so the IDs return to
+        # the node pool only at worker death (see _handle_worker_death).
+        self.tpu_chips: List[int] = []
+        # True once any task ran here: a used worker may have initialized
+        # jax on CPU, so chip grants (which flip JAX_PLATFORMS before the
+        # first jax import) only go to fresh processes.
+        self.used = False
 
 
 _task_seq = 0
@@ -98,6 +109,9 @@ class TaskRecord:
         # chosen raylet while its worker pool spins up a worker).
         self.parked_node: Optional[NodeID] = None
         self.park_time = 0.0
+        # Concrete TPU chip IDs granted at dispatch (tasks requesting
+        # {"TPU": n}); freed back to the node's pool with the resources.
+        self.tpu_chips: Optional[List[int]] = None
 
     @property
     def is_actor_task(self) -> bool:
@@ -1370,7 +1384,9 @@ class Head:
                     failed_shapes.add(shape)
                     requeue.append(task)
                     continue
-                worker = self._find_idle_worker(node_id)
+                worker = self._find_idle_worker(
+                    node_id, fresh=self._needs_chip_grant(task)
+                )
                 if worker is None:
                     # Commit to the picked node: hold the resources, park
                     # until a worker registers or frees up there.  Actors get
@@ -1378,14 +1394,23 @@ class Head:
                     # tasks respect the cap.
                     self._maybe_spawn(
                         node_id,
-                        force=bool(task.spec.get("is_actor_creation")),
+                        force=bool(task.spec.get("is_actor_creation"))
+                        or self._needs_chip_grant(task),
                     )
                     task.parked_node = node_id
                     task.park_time = time.monotonic()
                     self.node_parked.setdefault(node_id, deque()).append(task)
                     made_progress = True  # resource state changed
                     continue
-                await self._dispatch(task, worker)
+                if not await self._dispatch(task, worker):
+                    # Chip-starved: floats freed up but no concrete chip IDs
+                    # yet (a blocked holder's process still maps them).
+                    self.scheduler.release(
+                        node_id, task.resources, task.strategy
+                    )
+                    failed_shapes.add(shape)
+                    requeue.append(task)
+                    continue
                 made_progress = True
             self.queued_tasks.extend(requeue)
 
@@ -1399,16 +1424,29 @@ class Head:
                 if task.state != PENDING:
                     q.popleft()
                     continue
-                worker = self._find_idle_worker(node_id)
+                worker = self._find_idle_worker(
+                    node_id, fresh=self._needs_chip_grant(task)
+                )
                 if worker is None:
                     self._maybe_spawn(
                         node_id,
-                        force=bool(task.spec.get("is_actor_creation")),
+                        force=bool(task.spec.get("is_actor_creation"))
+                        or self._needs_chip_grant(task),
                     )
                     break
+                # Pop BEFORE the dispatch await: a concurrent pass must not
+                # see an already-dispatched task at q[0] (it would pop it
+                # and this coroutine's pop would then drop the next task).
                 q.popleft()
                 task.parked_node = None
-                await self._dispatch(task, worker)
+                if not await self._dispatch(task, worker):
+                    # Chip-starved: _dispatch refused before any await, so
+                    # no other pass ran in between — put it back at the
+                    # front and stay parked (resources held) until the
+                    # retiring holder's process exits and frees the IDs.
+                    task.parked_node = node_id
+                    q.appendleft(task)
+                    break
             if not q:
                 self.node_parked.pop(node_id, None)
 
@@ -1433,9 +1471,21 @@ class Head:
             if self.scheduler.reschedule_lost_bundles(pg_id):
                 self.pgs_needing_bundles.discard(pg_id)
 
-    def _find_idle_worker(self, node_id: NodeID) -> Optional[WorkerState]:
+    @staticmethod
+    def _needs_chip_grant(task: TaskRecord) -> bool:
+        # Actor METHOD tasks run in the actor's process, which got its grant
+        # at creation.  Fractional (<1) requests are admission-only time
+        # sharing: no visibility isolation (two processes cannot map the
+        # same chip concurrently anyway).
+        return (int(task.resources.get("TPU", 0)) >= 1
+                and not task.is_actor_task)
+
+    def _find_idle_worker(
+        self, node_id: NodeID, fresh: bool = False
+    ) -> Optional[WorkerState]:
         for w in self.workers.values():
-            if w.node_id == node_id and w.state == IDLE and w.conn.alive:
+            if w.node_id == node_id and w.state == IDLE and w.conn.alive \
+                    and not (fresh and w.used):
                 return w
         return None
 
@@ -1473,16 +1523,35 @@ class Head:
             parked_creations = sum(
                 1 for t in self.node_parked.get(node_id, ())
                 if t.spec.get("is_actor_creation")
+                or self._needs_chip_grant(t)
             )
             needed = max(parked_creations, 1)
             for _ in range(min(needed - pending,
                                hard_cap - (count + blocked + pending))):
                 self._spawn_worker(node_id)
 
-    async def _dispatch(self, task: TaskRecord, worker: WorkerState):
+    async def _dispatch(self, task: TaskRecord, worker: WorkerState) -> bool:
+        # Tasks that hold scheduler resources and request whole chips get
+        # concrete chip IDs so the worker can isolate the TPU view
+        # (reference: tpu.py:155 TPU_VISIBLE_CHIPS assignment at task start).
+        # No IDs free (a blocked chip-holder released its float but its
+        # process still maps the devices): refuse to dispatch — running the
+        # task without a grant would silently compute on CPU.
+        n_tpu = int(task.resources.get("TPU", 0))
+        if n_tpu >= 1 and not task.is_actor_task:
+            task.tpu_chips = self.scheduler.allocate_tpu_chips(
+                worker.node_id, n_tpu
+            )
+            if task.tpu_chips is None:
+                return False
+            worker.tpu_chips.extend(task.tpu_chips)
+            task.spec["tpu_chips"] = task.tpu_chips
+        else:
+            task.spec.pop("tpu_chips", None)
         task.state = RUNNING
         task.worker_id = worker.worker_id
         task.node_id = worker.node_id
+        worker.used = True
         task.start_time = time.time()
         worker.last_seen = time.monotonic()
         is_actor_creation = task.spec.get("is_actor_creation", False)
@@ -1497,6 +1566,7 @@ class Head:
             actor.node_id = worker.node_id
             worker.actor_id = actor_id
         await worker.conn.push("execute_task", task.spec)
+        return True
 
     async def h_task_done(self, conn, body):
         task_id = TaskID(body["task_id"])
@@ -1607,6 +1677,30 @@ class Head:
         self._kick()
         return {}
 
+    def _retire_worker(self, worker: WorkerState):
+        """Tell a chip-granted pooled worker to exit: its process keeps the
+        TPU devices mapped, so the chip IDs only become reusable at process
+        death (reference: raylet kills GPU workers whose CUDA_VISIBLE_DEVICES
+        grant must be reclaimed rather than re-leasing the process)."""
+        if worker.state in (DEAD, RETIRING):
+            return
+        worker.state = RETIRING
+        if worker.conn.alive:
+            async def _push_exit():
+                try:
+                    await worker.conn.push("exit", {})
+                except Exception:
+                    pass  # racing the SIGTERM below is expected
+
+            asyncio.ensure_future(_push_exit())
+        if worker.node_id == self.local_node_id:
+            # Belt and braces for wedged processes; remote nodes reap via
+            # their daemon when the connection drops.
+            try:
+                os.kill(worker.pid, 15)
+            except (ProcessLookupError, PermissionError):
+                pass
+
     def _release_task_resources(self, task, worker, keep_worker_busy=False):
         if task.is_actor_task:
             release = False  # actor method tasks hold no scheduler resources
@@ -1619,11 +1713,15 @@ class Head:
         # h_task_blocked (e.g. its unblock RPC was lost).
         if release and task.node_id is not None and not task.blocked:
             self.scheduler.release(task.node_id, task.resources, task.strategy)
+        if release and task.tpu_chips and worker is not None:
+            # The worker ran with a chip grant; the grant dies with the
+            # process (chips freed in _handle_worker_death).
+            self._retire_worker(worker)
         task.blocked = False
         if worker:
             worker.inflight.discard(task.task_id)
             worker.last_seen = time.monotonic()
-            if not keep_worker_busy:
+            if not keep_worker_busy and worker.state not in (RETIRING, DEAD):
                 worker.state = IDLE
 
     # -- blocked workers (reference: raylet releases the CPU lease while a
@@ -1788,6 +1886,7 @@ class Head:
         task.state = RUNNING
         task.worker_id = worker.worker_id
         task.node_id = worker.node_id
+        worker.used = True
         task.start_time = time.time()
         worker.inflight.add(task.task_id)
         await worker.conn.push("execute_task", task.spec)
@@ -1886,6 +1985,11 @@ class Head:
         self.node_worker_counts[worker.node_id] = max(
             0, self.node_worker_counts.get(worker.node_id, 1) - 1
         )
+        if worker.tpu_chips:
+            # The process is gone, so its TPU devices are actually free now.
+            self.scheduler.free_tpu_chips(worker.node_id, worker.tpu_chips)
+            worker.tpu_chips = []
+            self._kick()  # chip-starved parked tasks can dispatch
         # If this worker hosted an actor that will restart, its creation task
         # must not seal error objects (the restarted creation reuses them).
         will_restart_actor = False

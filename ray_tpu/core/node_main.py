@@ -168,6 +168,14 @@ class NodeDaemon:
         )
         self.resources = json.loads(os.environ.get("RT_NODE_RESOURCES", "{}"))
         self.labels = json.loads(os.environ.get("RT_NODE_LABELS", "{}"))
+        if "TPU" not in self.resources:
+            # Autodetect this host's chips and pod-slice topology (reference:
+            # tpu.py:97-117 /dev/accel* scan; tpu.py:198 pod resources).
+            from ray_tpu import accelerators
+
+            self.resources.update(accelerators.node_resources())
+            for k, v in accelerators.node_labels().items():
+                self.labels.setdefault(k, v)
         self.num_workers = int(os.environ.get("RT_NODE_NUM_WORKERS", "4"))
         self.host = os.environ.get("RT_NODE_HOST", "127.0.0.1")
         self.store = ObjectStore(

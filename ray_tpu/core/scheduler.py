@@ -71,6 +71,10 @@ class NodeState:
     available: ResourceDict
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     alive: bool = True
+    # Free TPU chip IDs on this host.  The float "TPU" resource governs
+    # *admission*; this pool assigns the concrete device indices a granted
+    # task may see (reference: tpu.py:155 TPU_VISIBLE_CHIPS isolation).
+    tpu_free: List[int] = dataclasses.field(default_factory=list)
 
     def utilization(self) -> float:
         worst = 0.0
@@ -120,9 +124,31 @@ class ClusterScheduler:
             total=dict(resources),
             available=dict(resources),
             labels=labels or {},
+            tpu_free=list(range(int(resources.get("TPU", 0)))),
         )
         self.nodes[node_id] = node
         return node
+
+    # -- TPU chip-ID pool -----------------------------------------------------
+
+    def allocate_tpu_chips(self, node_id: NodeID, n: int) -> Optional[List[int]]:
+        """Assign ``n`` concrete chip IDs on a node whose float "TPU"
+        resources were already acquired.  Returns None when the pool is
+        short (a blocked or retiring holder's process still maps the
+        devices) — the dispatcher then refuses to dispatch and the task
+        waits for a real chip (head._dispatch)."""
+        node = self.nodes.get(node_id)
+        if node is None or len(node.tpu_free) < n:
+            return None
+        chips = node.tpu_free[:n]
+        del node.tpu_free[:n]
+        return chips
+
+    def free_tpu_chips(self, node_id: NodeID, chips: List[int]) -> None:
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.tpu_free.extend(c for c in chips if c not in node.tpu_free)
+            node.tpu_free.sort()
 
     def remove_node(self, node_id: NodeID) -> List[PlacementGroupID]:
         """Drop a node.  Returns ids of placement groups that lost bundles

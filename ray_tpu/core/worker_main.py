@@ -284,6 +284,21 @@ class Worker:
             saved_env = {k: os.environ.get(k) for k in env_vars}
             for k, v in env_vars.items():
                 os.environ[k] = v
+            if spec.get("tpu_chips") is not None:
+                # Chip grant from the scheduler: narrow this process's TPU
+                # view before user code first imports jax (reference:
+                # tpu.py:155 set_current_process_visible_accelerator_ids runs
+                # in the worker at task start).  Takes effect only when jax
+                # has not initialized its backend in this process yet — chip
+                # tasks should land on fresh workers (dedicated actor
+                # processes do by construction).
+                from ray_tpu import accelerators
+
+                tpu_keys = ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_HOST_BOUNDS",
+                            "TPU_HOST_BOUNDS", "JAX_PLATFORMS")
+                for k in tpu_keys:
+                    saved_env.setdefault(k, os.environ.get(k))
+                accelerators.apply_visibility(spec["tpu_chips"])
             if renv.get("working_dir_key"):
                 saved_cwd = os.getcwd()
                 saved_wd_path = self._setup_working_dir(

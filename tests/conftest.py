@@ -13,6 +13,9 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Keep accelerator-tunnel sitecustomize hooks dormant in test workers.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Deterministic TPU autodetect: the machine under test may expose real
+# /dev/accel* chips; tests that want chips mock them via RT_TPU_CHIPS.
+os.environ.setdefault("RT_TPU_CHIPS", "0")
 
 # A sitecustomize hook (TPU tunnel) plus pytest plugins (jaxtyping) can
 # import jax and initialize the TPU backend before this conftest runs —

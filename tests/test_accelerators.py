@@ -1,0 +1,211 @@
+"""TPU accelerator support: autodetect, pod resources, chip-ID isolation.
+
+Mirrors the reference's accelerator-manager tests
+(reference: python/ray/tests/accelerators/test_tpu.py) with the /dev scan
+mocked via RT_TPU_CHIPS.
+"""
+
+import os
+
+import pytest
+
+from ray_tpu import accelerators
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.scheduler import ClusterScheduler
+
+
+@pytest.fixture
+def tpu_host(monkeypatch):
+    """Pretend this host has a 4-chip v5e slice, worker 0."""
+    monkeypatch.setenv("RT_TPU_CHIPS", "4")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_NAME", "my-tpu")
+    yield
+
+
+class TestDetection:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RT_TPU_CHIPS", "8")
+        assert accelerators.num_chips() == 8
+
+    def test_no_chips(self, monkeypatch):
+        monkeypatch.setenv("RT_TPU_CHIPS", "0")
+        assert accelerators.num_chips() == 0
+        assert accelerators.node_resources() == {}
+
+    def test_pod_type_validation(self):
+        assert accelerators.is_valid_pod_type("v5e-8")
+        assert accelerators.is_valid_pod_type("v4-16")
+        assert accelerators.is_valid_pod_type("v5litepod-16")
+        assert not accelerators.is_valid_pod_type("tpu-v4")
+        assert not accelerators.is_valid_pod_type("v4")
+
+    def test_node_resources_with_pod(self, tpu_host):
+        res = accelerators.node_resources()
+        assert res["TPU"] == 4.0
+        assert res["TPU-V5E"] == 4.0
+        assert res["TPU-v5e-8-head"] == 1.0
+
+    def test_non_head_worker_has_no_head_marker(self, tpu_host, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_ID", "1")
+        res = accelerators.node_resources()
+        assert "TPU-v5e-8-head" not in res
+
+    def test_labels(self, tpu_host):
+        labels = accelerators.node_labels()
+        assert labels == {
+            "tpu-pod-type": "v5e-8",
+            "tpu-name": "my-tpu",
+            "tpu-worker-id": "0",
+        }
+
+    def test_pod_worker_count(self):
+        assert accelerators.pod_worker_count("v4-16") == 2   # cores, 8/host
+        assert accelerators.pod_worker_count("v5e-8") == 2   # chips, 4/host
+        assert accelerators.pod_worker_count("v5e-4") == 1
+
+    def test_validate_request(self):
+        assert accelerators.validate_request(1) is None
+        assert accelerators.validate_request(8) is None
+        assert accelerators.validate_request(0.5) is None
+        assert accelerators.validate_request(3) is not None
+
+
+class TestVisibilityEnv:
+    def test_single_chip(self, tpu_host):
+        env = accelerators.visibility_env([2], host_chips=4)
+        assert env["TPU_VISIBLE_CHIPS"] == "2"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,1,1"
+        assert env["TPU_HOST_BOUNDS"] == "1,1,1"
+
+    def test_two_chips(self, tpu_host):
+        env = accelerators.visibility_env([1, 3], host_chips=4)
+        assert env["TPU_VISIBLE_CHIPS"] == "1,3"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
+
+    def test_all_chips_clears_bounds(self, tpu_host):
+        env = accelerators.visibility_env([0, 1, 2, 3], host_chips=4)
+        assert env["TPU_VISIBLE_CHIPS"] == ""
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == ""
+
+    def test_apply_sets_and_clears(self, tpu_host, monkeypatch):
+        # Register every var apply_visibility mutates so monkeypatch
+        # restores them — a leaked JAX_PLATFORMS=tpu,cpu would poison every
+        # worker spawned by later tests in this process.
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("TPU_VISIBLE_CHIPS", "stale")
+        monkeypatch.setenv("TPU_HOST_BOUNDS", "stale")
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "stale")
+        accelerators.apply_visibility([0, 1, 2, 3], host_chips=4)
+        assert "TPU_CHIPS_PER_HOST_BOUNDS" not in os.environ
+        accelerators.apply_visibility([1], host_chips=4)
+        assert os.environ["TPU_VISIBLE_CHIPS"] == "1"
+        assert os.environ["JAX_PLATFORMS"] == "tpu,cpu"
+
+
+class TestChipPool:
+    def _sched(self, n_tpu=4):
+        s = ClusterScheduler()
+        nid = NodeID.from_random()
+        s.add_node(nid, {"CPU": 4, "TPU": float(n_tpu)})
+        return s, nid
+
+    def test_allocate_and_free(self):
+        s, nid = self._sched()
+        chips = s.allocate_tpu_chips(nid, 2)
+        assert chips == [0, 1]
+        assert s.allocate_tpu_chips(nid, 2) == [2, 3]
+        assert s.allocate_tpu_chips(nid, 1) is None  # pool exhausted
+        s.free_tpu_chips(nid, chips)
+        assert s.allocate_tpu_chips(nid, 2) == [0, 1]
+
+    def test_double_free_is_idempotent(self):
+        s, nid = self._sched()
+        chips = s.allocate_tpu_chips(nid, 2)
+        s.free_tpu_chips(nid, chips)
+        s.free_tpu_chips(nid, chips)
+        assert len(s.nodes[nid].tpu_free) == 4
+
+    def test_free_on_dead_node_is_noop(self):
+        s, nid = self._sched()
+        chips = s.allocate_tpu_chips(nid, 2)
+        s.remove_node(nid)
+        s.free_tpu_chips(nid, chips)  # must not raise
+
+
+class TestEndToEnd:
+    def test_task_sees_visible_chips(self, monkeypatch):
+        """A task requesting {"TPU": 1} runs with TPU_VISIBLE_CHIPS set to
+        its granted chip, and the grant returns to the pool afterwards."""
+        monkeypatch.setenv("RT_TPU_CHIPS", "2")
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4)
+        try:
+            @ray_tpu.remote(resources={"TPU": 1}, num_cpus=0)
+            def which_chips():
+                return os.environ.get("TPU_VISIBLE_CHIPS")
+
+            seen = ray_tpu.get([which_chips.remote() for _ in range(2)])
+            assert all(v in ("0", "1") for v in seen)
+
+            # Pool drains and refills: run more rounds than chips.
+            seen2 = ray_tpu.get([which_chips.remote() for _ in range(4)])
+            assert all(v in ("0", "1") for v in seen2)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_actor_holds_chip_until_death(self, monkeypatch):
+        monkeypatch.setenv("RT_TPU_CHIPS", "1")
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4)
+        try:
+            @ray_tpu.remote(resources={"TPU": 1}, num_cpus=0)
+            class ChipHolder:
+                def chips(self):
+                    # Full-host grant: visibility stays default (reference
+                    # clears the bounds when all chips are granted), but the
+                    # worker flips JAX back onto the TPU platform.
+                    return (os.environ.get("TPU_VISIBLE_CHIPS"),
+                            os.environ.get("JAX_PLATFORMS"))
+
+            holder = ChipHolder.remote()
+            assert ray_tpu.get(holder.chips.remote()) == (None, "tpu,cpu")
+
+            # The sole chip is held: a second TPU task must not schedule.
+            @ray_tpu.remote(resources={"TPU": 1}, num_cpus=0)
+            def probe():
+                return True
+
+            ready, not_ready = ray_tpu.wait([probe.remote()], timeout=0.5)
+            assert not ready
+
+            ray_tpu.kill(holder)
+            # After the actor dies the chip frees and the probe runs.
+            assert ray_tpu.get(not_ready[0], timeout=20)
+        finally:
+            ray_tpu.shutdown()
+
+
+def test_invalid_chip_request_rejected(monkeypatch):
+    monkeypatch.setenv("RT_TPU_CHIPS", "8")
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(resources={"TPU": 3}, num_cpus=0)
+        def bad():
+            return 1
+
+        with pytest.raises(ValueError, match="TPU=3"):
+            bad.remote()
+    finally:
+        ray_tpu.shutdown()
