@@ -21,6 +21,7 @@ class RuntimeContext:
         self.current_actor_id = None
         self.head_process = None  # in-driver head thread, if we started one
         self.namespace: str = "default"
+        self.dashboard = None  # dashboard.Dashboard, if started via init()
 
     @property
     def initialized(self) -> bool:

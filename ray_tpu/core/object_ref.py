@@ -78,13 +78,16 @@ class ObjectRef:
         return isinstance(other, ObjectRef) and other._id == self._id
 
     def __del__(self):
-        if self._owned and ctx.client is not None:
+        # `ctx` can already be None during interpreter shutdown (module
+        # globals cleared before the last refs are collected).
+        client = ctx.client if ctx is not None else None
+        if self._owned and client is not None:
             raw = self._id.binary()
             with _free_lock:
                 _free_queue.append(raw)
             # Wake the client's flusher thread; large objects get a prompt
             # flush (their segments should return to the warm pool fast).
-            if len(_free_queue) >= 16 or raw in ctx.client.large_oids:
+            if len(_free_queue) >= 16 or raw in client.large_oids:
                 flush_wanted.set()
 
     def __reduce__(self):

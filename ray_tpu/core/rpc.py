@@ -280,13 +280,26 @@ class RpcClient:
         if self.closed:
             return
         self.closed = True
+        # Voluntary close: the lost-connection callback is for peer death,
+        # not for our own teardown.
+        self.on_connection_lost = None
 
         def _shutdown():
-            if self._reader_task is not None:
-                self._reader_task.cancel()
-            if self._writer is not None:
-                self._writer.close()
-            self._loop.stop()
+            async def _graceful():
+                task = self._reader_task
+                if task is not None:
+                    task.cancel()
+                    try:
+                        # Let the cancellation unwind (its finally runs) so
+                        # the loop doesn't destroy a pending task at stop.
+                        await task
+                    except BaseException:  # noqa: BLE001 — CancelledError
+                        pass
+                if self._writer is not None:
+                    self._writer.close()
+                self._loop.stop()
+
+            asyncio.ensure_future(_graceful())
 
         self._loop.call_soon_threadsafe(_shutdown)
         self._thread.join(timeout=5)

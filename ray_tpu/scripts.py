@@ -141,6 +141,26 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    """Serve the web dashboard against a running cluster (reference:
+    dashboard/head.py runs as its own process attached to the GCS)."""
+    from .dashboard import Dashboard
+
+    dash = Dashboard(
+        _resolve_address(args.address), host=args.host, port=args.port
+    ).start()
+    print(f"dashboard at {dash.url} (ctrl-c to stop)")
+    try:
+        import signal
+
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        dash.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ray_tpu")
     ap.add_argument("--address", default=None)
@@ -166,6 +186,11 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("timeline", help="task event timeline (json)")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     args = ap.parse_args(argv)
     return args.fn(args)
