@@ -126,3 +126,69 @@ class EnvRunner:
             "observation_size": self.vec.observation_size,
             "num_actions": self.vec.num_actions,
         }
+
+    # -- off-policy sampling (DQN-family) ------------------------------------
+
+    def set_q_weights(self, weights) -> bool:
+        """Install Q-network params (a QParams pytree from rllib.dqn)."""
+        import jax.numpy as jnp
+
+        from .dqn import QParams
+
+        self._params = QParams(*[jnp.asarray(w) for w in weights])
+        return True
+
+    def sample_transitions(self, num_steps: int,
+                           epsilon: float) -> Dict[str, np.ndarray]:
+        """Collect flat (s, a, r, s', done) transitions with epsilon-greedy
+        exploration for replay-buffer algorithms (reference:
+        single_agent_env_runner.py:131 sample — episodes are post-processed
+        into transition batches by the DQN pipeline; here the runner emits
+        transitions directly).
+
+        ``done`` marks *termination only*: a time-limit truncation still
+        bootstraps from V/Q of the true next state (same semantics as the
+        PPO path's bootstrap_values)."""
+        assert self._params is not None, "set_q_weights before sample"
+        if getattr(self, "_q_forward", None) is None:
+            import jax
+
+            from .dqn import q_forward
+
+            self._q_forward = jax.jit(q_forward)
+        fwd = self._q_forward
+        N = self.vec.num_envs
+        D = self.vec.observation_size
+        obs_buf = np.empty((num_steps, N, D), np.float32)
+        next_buf = np.empty((num_steps, N, D), np.float32)
+        act_buf = np.empty((num_steps, N), np.int32)
+        rew_buf = np.empty((num_steps, N), np.float32)
+        done_buf = np.empty((num_steps, N), np.float32)
+        obs = self.obs
+        for t in range(num_steps):
+            q = np.asarray(fwd(self._params, obs))
+            actions = np.argmax(q, axis=-1).astype(np.int32)
+            explore = self._rng.random(N) < epsilon
+            actions = np.where(
+                explore,
+                self._rng.integers(0, self.vec.num_actions, N),
+                actions,
+            ).astype(np.int32)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = terms.astype(np.float32)
+            next_buf[t] = obs
+            for i, o in final_obs.items():
+                next_buf[t, i] = o  # true pre-reset successor state
+        self.obs = obs
+        return {
+            "obs": obs_buf.reshape(num_steps * N, D),
+            "next_obs": next_buf.reshape(num_steps * N, D),
+            "actions": act_buf.reshape(-1),
+            "rewards": rew_buf.reshape(-1),
+            "dones": done_buf.reshape(-1),
+            "episode_returns": np.array(self.vec.drain_completed(),
+                                        np.float64),
+        }
