@@ -7,8 +7,16 @@ resources_per_trial, and gang trials compose with ray_tpu.train inside the
 trainable.
 """
 
-from .schedulers import ASHAScheduler, FIFOScheduler
+from .schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
 from .search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
     choice,
     grid_search,
     loguniform,
@@ -23,13 +31,16 @@ from .tuner import (
     TuneError,
     TuneInterrupted,
     Tuner,
+    get_checkpoint,
     get_trial_dir,
     report,
 )
 
 __all__ = [
     "Tuner", "TuneConfig", "TuneError", "TuneInterrupted",
-    "Result", "ResultGrid", "report", "get_trial_dir",
+    "Result", "ResultGrid", "report", "get_trial_dir", "get_checkpoint",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "sample_from", "ASHAScheduler", "FIFOScheduler",
+    "sample_from", "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "Searcher", "BasicVariantGenerator",
+    "ConcurrencyLimiter",
 ]

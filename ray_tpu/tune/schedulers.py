@@ -95,3 +95,177 @@ class ASHAScheduler:
                 if result[self.time_attr] >= rung.t \
                         and trial_id not in rung.recorded:
                     rung.recorded[trial_id] = self._value(result)
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running mean falls below the median of other
+    trials' running means (reference: tune/schedulers/median_stopping_rule.py
+    MedianStoppingRule — the original Vizier rule)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples_required = min_samples_required
+        self._values: Dict[str, List[float]] = {}
+
+    def _value(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        if self.metric not in result or self.time_attr not in result:
+            return CONTINUE
+        self._values.setdefault(trial_id, []).append(self._value(result))
+        if result[self.time_attr] < self.grace_period:
+            return CONTINUE
+        other_means = [
+            sum(vs) / len(vs)
+            for tid, vs in self._values.items()
+            if tid != trial_id and vs
+        ]
+        if len(other_means) < self.min_samples_required:
+            return CONTINUE
+        other_means.sort()
+        median = other_means[len(other_means) // 2]
+        mine = self._values[trial_id]
+        if sum(mine) / len(mine) < median:
+            return STOP
+        return CONTINUE
+
+    def on_complete(self, trial_id: str, result: Dict) -> None:
+        pass
+
+
+class PopulationBasedTraining:
+    """PBT: bottom-quantile trials clone a top-quantile trial's checkpoint
+    and continue with perturbed hyperparameters (reference:
+    tune/schedulers/pbt.py PopulationBasedTraining — exploit via
+    checkpoint copy, explore via resample-or-scale).
+
+    Requires cooperative trainables: they must pass ``checkpoint=<dir>`` to
+    ``tune.report`` and restore from ``tune.get_checkpoint()`` at start.
+    The Tuner relaunches an exploited trial's function from the source
+    trial's checkpoint with the perturbed config.
+    """
+
+    #: Tuner passes (result, checkpoint=..., config=...) to on_result.
+    wants_context = True
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Dict | None = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: int = 0,
+    ):
+        assert mode in ("min", "max")
+        assert 0 < quantile_fraction <= 0.5
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.perturbation_interval = perturbation_interval
+        self.hyperparam_mutations = hyperparam_mutations or {}
+        self.quantile_fraction = quantile_fraction
+        self.resample_probability = resample_probability
+        import random
+
+        self._rng = random.Random(seed)
+        self._scores: Dict[str, float] = {}        # latest normalized score
+        self._checkpoints: Dict[str, str] = {}     # latest checkpoint dir
+        self._configs: Dict[str, Dict] = {}
+        self._last_perturb: Dict[str, float] = {}
+        self.num_exploits = 0
+
+    def _value(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    # -- explore -------------------------------------------------------------
+
+    def _explore(self, config: Dict) -> Dict:
+        """Perturb the source config (reference: pbt.py explore: resample
+        with probability ``resample_probability``, else scale numeric values
+        by 1.2/0.8 or step categorical values to a neighbor)."""
+        new = dict(config)
+        for key, spec in self.hyperparam_mutations.items():
+            resample = self._rng.random() < self.resample_probability
+            cur = new.get(key)
+            if callable(spec):
+                if resample or not isinstance(cur, (int, float)):
+                    new[key] = spec()
+                else:
+                    new[key] = cur * self._rng.choice((0.8, 1.2))
+            elif isinstance(spec, (list, tuple)):
+                if resample or cur not in spec:
+                    new[key] = self._rng.choice(list(spec))
+                else:
+                    i = list(spec).index(cur)
+                    j = max(0, min(len(spec) - 1,
+                                   i + self._rng.choice((-1, 1))))
+                    new[key] = list(spec)[j]
+            elif hasattr(spec, "sample"):  # search.Domain
+                if resample or not isinstance(cur, (int, float)):
+                    new[key] = spec.sample(self._rng)
+                else:
+                    new[key] = cur * self._rng.choice((0.8, 1.2))
+            elif isinstance(cur, (int, float)):
+                new[key] = cur * self._rng.choice((0.8, 1.2))
+        return new
+
+    # -- scheduler protocol ---------------------------------------------------
+
+    def on_trial_add(self, trial_id: str, config: Dict, trial_dir: str):
+        self._configs[trial_id] = dict(config)
+
+    def on_result(self, trial_id: str, result: Dict, checkpoint=None,
+                  config=None):
+        if config is not None:
+            self._configs[trial_id] = dict(config)
+        if checkpoint:
+            self._checkpoints[trial_id] = checkpoint
+        if self.metric not in result or self.time_attr not in result:
+            return CONTINUE
+        t = result[self.time_attr]
+        self._scores[trial_id] = self._value(result)
+        if t - self._last_perturb.get(trial_id, 0) < self.perturbation_interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        if len(self._scores) < 2:
+            return CONTINUE
+        ranked = sorted(self._scores, key=self._scores.get)
+        k = max(1, int(len(ranked) * self.quantile_fraction))
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial_id not in bottom:
+            return CONTINUE
+        sources = [tid for tid in top
+                   if tid != trial_id and tid in self._checkpoints]
+        if not sources:
+            return CONTINUE
+        source = self._rng.choice(sources)
+        self.num_exploits += 1
+        return {
+            "decision": "exploit",
+            "config": self._explore(self._configs[source]),
+            "restore_from": self._checkpoints[source],
+            "source": source,
+        }
+
+    def on_complete(self, trial_id: str, result: Dict) -> None:
+        # Completed trials stay in the population: their final scores keep
+        # the quantiles honest and their checkpoints remain valid exploit
+        # sources for stragglers.
+        pass

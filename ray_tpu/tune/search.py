@@ -141,3 +141,68 @@ def generate_variants(
             assign = dict(zip(grid_paths, combo))
             variants.append(_resolve(param_space, assign, rng))
     return variants
+
+
+# ------------------------------------------------------- incremental search
+
+
+class Searcher:
+    """Suggest-based search algorithm (reference: tune/search/searcher.py
+    Searcher — suggest/on_trial_complete).  Unlike `generate_variants`'
+    eager expansion, a Searcher produces configs one at a time so it can
+    condition later suggestions on earlier results."""
+
+    def suggest(self, trial_id: str) -> Dict[str, Any] | None:
+        """The next config to try, or None to signal 'nothing right now'
+        (the Tuner retries later)."""
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Dict[str, Any] | None = None) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Adapts the eager variant expansion to the Searcher protocol
+    (reference: tune/search/basic_variant.py BasicVariantGenerator)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: int = 0):
+        self._variants = generate_variants(param_space, num_samples, seed)
+        self._next = 0
+
+    def suggest(self, trial_id: str):
+        if self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg
+
+    @property
+    def exhausted(self) -> bool:
+        return self._next >= len(self._variants)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions from the wrapped searcher (reference:
+    tune/search/concurrency_limiter.py ConcurrencyLimiter) — needed when a
+    conditioned searcher degrades to random sampling if too many trials run
+    before any results arrive."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        assert max_concurrent >= 1
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result=None):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)

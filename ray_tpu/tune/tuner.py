@@ -51,18 +51,24 @@ class _StopTrial(BaseException):
 
 
 class _TrialSession:
-    def __init__(self, trial_id: str, trial_dir: str):
+    def __init__(self, trial_id: str, trial_dir: str,
+                 restore_path: Optional[str] = None):
         self.trial_id = trial_id
         self.trial_dir = trial_dir
+        self.restore_path = restore_path
         self.queue: "queue.Queue" = queue.Queue()
         self.iteration = 0
         self.stop_requested = False
 
-    def report(self, metrics: Dict[str, Any]):
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[str] = None):
         self.iteration += 1
         out = dict(metrics)
         out.setdefault("training_iteration", self.iteration)
-        self.queue.put({"kind": "report", "metrics": out})
+        ev = {"kind": "report", "metrics": out}
+        if checkpoint is not None:
+            ev["checkpoint"] = checkpoint
+        self.queue.put(ev)
         if self.stop_requested:
             raise _StopTrial()
 
@@ -70,18 +76,29 @@ class _TrialSession:
 _session: Optional[_TrialSession] = None
 
 
-def report(metrics: Dict[str, Any]) -> None:
+def report(metrics: Dict[str, Any], checkpoint: Optional[str] = None) -> None:
     """Report intermediate metrics from inside a trial (reference:
-    ray.tune.report / session.report)."""
+    ray.tune.report / session.report).  ``checkpoint`` is a directory the
+    trainable saved this round — registering it enables PBT exploitation
+    and best-checkpoint tracking."""
     if _session is None:
         raise RuntimeError("tune.report() called outside a Tuner trial")
-    _session.report(metrics)
+    _session.report(metrics, checkpoint=checkpoint)
 
 
 def get_trial_dir() -> str:
     if _session is None:
         raise RuntimeError("not inside a Tuner trial")
     return _session.trial_dir
+
+
+def get_checkpoint() -> Optional[str]:
+    """Checkpoint directory to restore from, when the controller relaunched
+    this trial from another trial's checkpoint (PBT exploit) or a prior run
+    (reference: ray.tune.get_checkpoint)."""
+    if _session is None:
+        raise RuntimeError("not inside a Tuner trial")
+    return _session.restore_path
 
 
 @ray_tpu.remote(max_concurrency=4)
@@ -96,7 +113,9 @@ class _TrialRunner:
         global _session
         import ray_tpu.tune.tuner as tuner_mod
 
-        sess = _TrialSession(trial_id, trial_dir)
+        config = dict(config)
+        restore_path = config.pop("_tune_restore_path", None)
+        sess = _TrialSession(trial_id, trial_dir, restore_path=restore_path)
         self._session = sess
         tuner_mod._session = sess
         final: Dict[str, Any] = {}
@@ -147,6 +166,10 @@ class Trial:
         self.error: Optional[str] = None
         self.actor = None
         self.run_ref = None
+        self.latest_checkpoint: Optional[str] = None
+        # Set when a PBT exploit decision is in flight: the trial stops,
+        # then relaunches from the source trial's checkpoint.
+        self.pending_exploit: Optional[dict] = None
 
     def to_json(self) -> dict:
         return {
@@ -240,6 +263,7 @@ class TuneConfig:
         num_samples: int = 1,
         max_concurrent_trials: Optional[int] = None,
         scheduler=None,
+        search_alg=None,
         resources_per_trial: Optional[Dict[str, float]] = None,
         seed: int = 0,
     ):
@@ -249,6 +273,10 @@ class TuneConfig:
         self.num_samples = num_samples
         self.max_concurrent_trials = max_concurrent_trials
         self.scheduler = scheduler or FIFOScheduler()
+        # Incremental searcher (search.Searcher, possibly wrapped in a
+        # ConcurrencyLimiter); None -> eager variant expansion of
+        # param_space (reference: tune_config.py search_alg).
+        self.search_alg = search_alg
         self.resources_per_trial = resources_per_trial or {"CPU": 1}
         self.seed = seed
 
@@ -397,13 +425,24 @@ class Tuner:
             )
             self._experiment_dir = os.path.join(storage, name)
         os.makedirs(self._experiment_dir, exist_ok=True)
-        if self._trials is None:
-            variants = generate_variants(
-                self.param_space, cfg.num_samples, cfg.seed
+        searcher = cfg.search_alg
+        if searcher is not None and self.param_space:
+            raise TuneError(
+                "pass the search space to the search_alg, not param_space — "
+                "with search_alg set, param_space would be silently ignored"
             )
-            self._trials = [
-                Trial(f"trial_{i:05d}", v) for i, v in enumerate(variants)
-            ]
+        if self._trials is None:
+            if searcher is not None:
+                # Incremental: trials materialize as the searcher suggests
+                # them (bounded by num_samples) in the loop below.
+                self._trials = []
+            else:
+                variants = generate_variants(
+                    self.param_space, cfg.num_samples, cfg.seed
+                )
+                self._trials = [
+                    Trial(f"trial_{i:05d}", v) for i, v in enumerate(variants)
+                ]
         self._save_state()
 
         from ..train.trainer import DataParallelTrainer
@@ -457,29 +496,67 @@ class Tuner:
         if cfg.resources_per_trial.get("TPU"):
             opts["num_tpus"] = cfg.resources_per_trial["TPU"]
 
+        def launch(trial: Trial, extra_config: Optional[dict] = None):
+            trial.actor = _TrialRunner.options(**opts).remote()
+            trial_dir = os.path.join(self._experiment_dir, trial.trial_id)
+            os.makedirs(trial_dir, exist_ok=True)
+            run_cfg = dict(trial.config)
+            if extra_config:
+                run_cfg.update(extra_config)
+            trial.run_ref = trial.actor.run.remote(
+                fn_blob, run_cfg, trial.trial_id, trial_dir
+            )
+            trial.status = RUNNING
+            if hasattr(scheduler, "on_trial_add"):
+                scheduler.on_trial_add(trial.trial_id, trial.config,
+                                       trial_dir)
+            self._save_state()
+
+        def scheduler_decision(trial: Trial, metrics: dict):
+            """Old-style schedulers take (trial_id, result); context-aware
+            ones (wants_context, e.g. PBT) also get checkpoint + config."""
+            if getattr(scheduler, "wants_context", False):
+                return scheduler.on_result(
+                    trial.trial_id, metrics,
+                    checkpoint=trial.latest_checkpoint,
+                    config=trial.config,
+                )
+            return scheduler.on_result(trial.trial_id, metrics)
+
         pending = [t for t in self._trials if t.status == PENDING]
+        suggested = len(self._trials)
         running: List[Trial] = []
         try:
-            while pending or running:
+            while pending or running or (
+                searcher is not None and suggested < cfg.num_samples
+            ):
                 if self._abort.is_set():
                     raise TuneInterrupted(
                         f"experiment interrupted; restore from "
                         f"{self._experiment_dir}"
                     )
+                # Pull new suggestions while capacity remains (reference:
+                # tune_controller asks the search algorithm for the next
+                # trial as slots free up).
+                while (searcher is not None and suggested < cfg.num_samples
+                       and len(running) + len(pending) < max_concurrent):
+                    trial_id = f"trial_{suggested:05d}"
+                    config = searcher.suggest(trial_id)
+                    if config is None:
+                        if not running and not pending:
+                            # Nothing in flight and nothing suggested: the
+                            # space is exhausted, not limiter-saturated.
+                            suggested = cfg.num_samples
+                        break
+                    trial = Trial(trial_id, config)
+                    suggested += 1
+                    self._trials.append(trial)
+                    pending.append(trial)
                 # Launch up to the concurrency cap (the controller loop —
                 # reference: tune_controller.py step:666).
                 while pending and len(running) < max_concurrent:
                     trial = pending.pop(0)
-                    trial.actor = _TrialRunner.options(**opts).remote()
-                    trial_dir = os.path.join(
-                        self._experiment_dir, trial.trial_id
-                    )
-                    os.makedirs(trial_dir, exist_ok=True)
-                    trial.run_ref = trial.actor.run.remote(
-                        fn_blob, trial.config, trial.trial_id, trial_dir
-                    )
-                    trial.status = RUNNING
-                    self._save_state()
+                    launch(trial)
                     running.append(trial)
                 # Drain reports per trial: one trial's dead worker (OOM,
                 # segfault) must fail that trial, not the experiment
@@ -496,6 +573,9 @@ class Tuner:
                         trial.error = f"trial actor died: {e}"
                         scheduler.on_complete(trial.trial_id,
                                               trial.last_result)
+                        if searcher is not None:
+                            searcher.on_trial_complete(trial.trial_id,
+                                                       trial.last_result)
                         trial.actor = None
                         self._save_state()
                         continue
@@ -503,15 +583,37 @@ class Tuner:
                     for ev in events:
                         if ev["kind"] == "report":
                             trial.last_result = ev["metrics"]
-                            decision = scheduler.on_result(
-                                trial.trial_id, ev["metrics"]
+                            if ev.get("checkpoint"):
+                                trial.latest_checkpoint = ev["checkpoint"]
+                            decision = scheduler_decision(
+                                trial, ev["metrics"]
                             )
                             if decision == STOP:
                                 try:
                                     trial.actor.request_stop.remote()
                                 except Exception:
                                     pass
+                            elif (isinstance(decision, dict)
+                                  and decision.get("decision") == "exploit"):
+                                # PBT: stop, then relaunch from the source
+                                # trial's checkpoint with perturbed config
+                                # (reference: pbt.py _exploit).
+                                trial.pending_exploit = decision
+                                try:
+                                    trial.actor.request_stop.remote()
+                                except Exception:
+                                    pass
                         elif ev["kind"] == "done":
+                            if trial.pending_exploit is not None \
+                                    and ev["status"] == STOPPED:
+                                exp = trial.pending_exploit
+                                trial.pending_exploit = None
+                                ray_tpu.kill(trial.actor)
+                                trial.config = exp["config"]
+                                launch(trial, extra_config={
+                                    "_tune_restore_path": exp["restore_from"]
+                                })
+                                continue
                             finished = True
                             trial.status = ev["status"]
                             if ev.get("final"):
@@ -521,6 +623,10 @@ class Tuner:
                             scheduler.on_complete(
                                 trial.trial_id, trial.last_result
                             )
+                            if searcher is not None:
+                                searcher.on_trial_complete(
+                                    trial.trial_id, trial.last_result
+                                )
                     if finished:
                         ray_tpu.kill(trial.actor)
                         trial.actor = None

@@ -247,3 +247,114 @@ def test_tuner_interrupt_and_restore(rt, tmp_path):
     rerun = ctx.client.kv_keys("ran:")
     assert len(rerun) == 8 - done_before  # only unfinished trials ran
     assert results.get_best_result("value", "max").metrics["value"] == 7
+
+
+# ----------------------------------------------------- new-style schedulers
+
+
+def test_median_stopping_rule_unit():
+    from ray_tpu.tune.schedulers import MedianStoppingRule
+
+    rule = MedianStoppingRule(metric="score", mode="max", grace_period=2,
+                              min_samples_required=2)
+    # Three trials: two good, one clearly below the median.
+    for it in (1, 2, 3):
+        assert rule.on_result("good1", {"score": 10.0,
+                                        "training_iteration": it}) == CONTINUE
+        assert rule.on_result("good2", {"score": 9.0,
+                                        "training_iteration": it}) == CONTINUE
+    assert rule.on_result("bad", {"score": 1.0,
+                                  "training_iteration": 1}) == CONTINUE  # grace
+    assert rule.on_result("bad", {"score": 1.0,
+                                  "training_iteration": 2}) == STOP
+
+
+def test_concurrency_limiter_unit():
+    from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+    base = BasicVariantGenerator({"x": tune.grid_search([1, 2, 3, 4])})
+    lim = ConcurrencyLimiter(base, max_concurrent=2)
+    a = lim.suggest("t0")
+    b = lim.suggest("t1")
+    assert a and b
+    assert lim.suggest("t2") is None  # saturated
+    lim.on_trial_complete("t0", {})
+    assert lim.suggest("t2") is not None
+
+
+def test_tuner_with_searcher(rt, tmp_path):
+    """Incremental search: a ConcurrencyLimiter-wrapped searcher feeds the
+    controller one config at a time."""
+    from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+    def trainable(config):
+        return {"value": config["x"] * 2}
+
+    searcher = ConcurrencyLimiter(
+        BasicVariantGenerator({"x": tune.grid_search([1, 2, 3, 4, 5])}),
+        max_concurrent=2,
+    )
+    results = tune.Tuner(
+        trainable,
+        tune_config=tune.TuneConfig(
+            metric="value", mode="max", num_samples=5, search_alg=searcher,
+        ),
+        run_config=ray_tpu.train.RunConfig(
+            name="searcher_exp", storage_path=str(tmp_path)
+        ),
+    ).fit()
+    assert len(results) == 5
+    assert results.get_best_result().metrics["value"] == 10
+
+
+def test_pbt_exploits_better_trial(rt, tmp_path):
+    """PBT: low-lr trials clone the high-lr trial's checkpoint and adopt a
+    perturbed lr, so every survivor ends near the best score (reference:
+    pbt.py — exploit copies weights, explore perturbs hyperparams)."""
+    import json
+    import os
+
+    from ray_tpu.tune.schedulers import PopulationBasedTraining
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        step, score = 0, 0.0
+        if ckpt:
+            with open(os.path.join(ckpt, "state.json")) as f:
+                state = json.load(f)
+            step, score = state["step"], state["score"]
+        while step < 12:
+            import time as _time
+
+            _time.sleep(0.08)  # slow enough that controller polls interleave
+            score += config["lr"]  # higher lr is strictly better here
+            step += 1
+            d = os.path.join(tune.get_trial_dir(), f"ckpt_{step}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step, "score": score}, f)
+            tune.report({"score": score, "training_iteration": step},
+                        checkpoint=d)
+        return None
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 0.5, 1.0]}, seed=7,
+    )
+    results = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 0.1, 1.0, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=pbt,
+            max_concurrent_trials=4,
+        ),
+        run_config=ray_tpu.train.RunConfig(
+            name="pbt_exp", storage_path=str(tmp_path)
+        ),
+    ).fit()
+    best = results.get_best_result().metrics["score"]
+    assert best >= 12 * 1.0 - 1e-6  # the lr=1.0 line reaches 12.0
+    assert pbt.num_exploits >= 1
+    # An exploited lr=0.1 trial must beat what lr=0.1 alone could score.
+    scores = sorted(r.metrics.get("score", 0.0) for r in results)
+    assert scores[1] > 12 * 0.1 + 1e-6, scores
