@@ -20,9 +20,11 @@ from .api import (
 )
 from .batching import batch
 from .handle import DeploymentHandle, DeploymentResponse
+from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "delete", "status",
     "shutdown", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "batch", "start_http", "stop_http",
+    "multiplexed", "get_multiplexed_model_id",
 ]

@@ -102,26 +102,61 @@ def deployment(_cls=None, *, name: Optional[str] = None,
 
 def run(app: Application, *, name: Optional[str] = None,
         wait_ready: bool = True, timeout: float = 120.0) -> DeploymentHandle:
-    """Deploy an application and return its handle (reference:
-    serve/api.py:510 serve.run)."""
+    """Deploy an application (and every application bound into its init
+    args) and return the ingress handle (reference: serve/api.py:510
+    serve.run; nested binds mirror the deployment-graph build at
+    serve/_private/deployment_graph_build.py — each node becomes its own
+    deployment and downstream nodes receive DeploymentHandles)."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
-    dep = app.deployment
-    dep_name = name or dep.name
     controller = get_or_create_controller()
-    ray_tpu.get(controller.deploy.remote(dep_name, dep.to_spec(app)),
-                timeout=timeout)
+    deployed: list = []
+    # Diamond reuse: the same bound Application object deploys once; two
+    # DIFFERENT binds of one class get suffixed names (reference:
+    # deployment_graph_build.py disambiguates duplicate node names).
+    seen: Dict[int, DeploymentHandle] = {}
+    used_names: Dict[str, int] = {}
+
+    def deploy_tree(a: Application, override_name: Optional[str] = None
+                    ) -> DeploymentHandle:
+        if id(a) in seen:
+            return seen[id(a)]
+        dep = a.deployment
+        dep_name = override_name or dep.name
+        if override_name is None:
+            n = used_names.get(dep_name, 0)
+            used_names[dep_name] = n + 1
+            if n:
+                dep_name = f"{dep_name}_{n + 1}"
+        args = tuple(
+            deploy_tree(x) if isinstance(x, Application) else x
+            for x in a.init_args
+        )
+        kwargs = {
+            k: deploy_tree(v) if isinstance(v, Application) else v
+            for k, v in a.init_kwargs.items()
+        }
+        resolved = Application(dep, args, kwargs)
+        ray_tpu.get(controller.deploy.remote(dep_name, dep.to_spec(resolved)),
+                    timeout=timeout)
+        deployed.append(dep_name)
+        handle = DeploymentHandle(dep_name)
+        seen[id(a)] = handle
+        return handle
+
+    handle = deploy_tree(app, override_name=name)
     if wait_ready:
         import time
 
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if ray_tpu.get(controller.ready.remote(dep_name), timeout=30):
-                break
-            time.sleep(0.1)
-        else:
-            raise TimeoutError(f"deployment {dep_name!r} not ready")
-    return DeploymentHandle(dep_name)
+        for dep_name in deployed:
+            while time.monotonic() < deadline:
+                if ray_tpu.get(controller.ready.remote(dep_name), timeout=30):
+                    break
+                time.sleep(0.1)
+            else:
+                raise TimeoutError(f"deployment {dep_name!r} not ready")
+    return handle
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

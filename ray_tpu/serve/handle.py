@@ -53,18 +53,27 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, method: str = "__call__"):
+    def __init__(self, deployment_name: str, method: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.method = method
+        self.multiplexed_model_id = multiplexed_model_id
         self._replicas: List[Any] = []
         self._version = -1
         self._last_refresh = 0.0
         self._local_load: Dict[int, int] = {}  # replica idx -> outstanding
         self._lock = threading.Lock()
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, method_name)
-        return h
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        """(reference: serve/handle.py .options — method_name and
+        multiplexed_model_id are the supported knobs here)."""
+        return DeploymentHandle(
+            self.deployment_name,
+            method_name if method_name is not None else self.method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self.multiplexed_model_id,
+        )
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -86,10 +95,17 @@ class DeploymentHandle:
 
     def _pick(self) -> int:
         """Power-of-two-choices on the handle's local outstanding counts
-        (the client-side view of queue pressure)."""
+        (the client-side view of queue pressure).  Multiplexed requests get
+        hash affinity instead: a model id sticks to one replica so repeated
+        requests hit its warm LRU (reference: the replica scheduler prefers
+        replicas that report the model id as loaded)."""
         n = len(self._replicas)
         if n == 1:
             return 0
+        if self.multiplexed_model_id:
+            import zlib
+
+            return zlib.crc32(self.multiplexed_model_id.encode()) % n
         i, j = random.sample(range(n), 2)
         return i if self._local_load.get(i, 0) <= self._local_load.get(j, 0) \
             else j
@@ -122,7 +138,8 @@ class DeploymentHandle:
 
         try:
             ref = replica.handle_request.remote(
-                self.method, args, kwargs
+                self.method, args, kwargs,
+                model_id=self.multiplexed_model_id,
             )
         except Exception:
             done()
@@ -134,7 +151,10 @@ class DeploymentHandle:
                 idx = self._pick()
                 replica = self._replicas[idx]
                 self._local_load[idx] = self._local_load.get(idx, 0) + 1
-            ref = replica.handle_request.remote(self.method, args, kwargs)
+            ref = replica.handle_request.remote(
+                self.method, args, kwargs,
+                model_id=self.multiplexed_model_id,
+            )
 
         def retry():
             self._refresh(force=True)
@@ -155,9 +175,14 @@ class DeploymentHandle:
                     )
                 self._local_load[i] = self._local_load.get(i, 0) + 1
                 state["idx"] = i
-            return rep.handle_request.remote(self.method, args, kwargs)
+            return rep.handle_request.remote(
+                self.method, args, kwargs,
+                model_id=self.multiplexed_model_id,
+            )
 
         return DeploymentResponse(ref, done, retry)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.method))
+        return (DeploymentHandle,
+                (self.deployment_name, self.method,
+                 self.multiplexed_model_id))

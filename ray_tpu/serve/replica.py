@@ -37,9 +37,13 @@ class ServeReplica:
         pow_2_scheduler.py)."""
         return self._ongoing
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             model_id: str = ""):
+        from .multiplex import _reset_model_id, _set_model_id
+
         with self._count_lock:
             self._ongoing += 1
+        token = _set_model_id(model_id)
         try:
             if self.user_fn is not None:
                 target = self.user_fn
@@ -51,13 +55,18 @@ class ServeReplica:
                 return await target(*args, **kwargs)
             # Sync callables run off-loop: blocking user code must not stall
             # the replica's event loop (concurrent requests keep flowing and
-            # queue pressure stays observable for autoscaling).
+            # queue pressure stays observable for autoscaling).  The model-id
+            # contextvar rides along via copy_context.
+            import contextvars as _cv
+
+            ctx = _cv.copy_context()
             out = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: target(*args, **kwargs)
+                None, lambda: ctx.run(target, *args, **kwargs)
             )
             if inspect.iscoroutine(out):
                 out = await out
             return out
         finally:
+            _reset_model_id(token)
             with self._count_lock:
                 self._ongoing -= 1

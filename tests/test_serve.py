@@ -159,3 +159,80 @@ def test_autoscaling_scales_up(rt):
         if serve.status()["Slow"]["running_replicas"] >= 2:
             scaled = True
     assert scaled, "autoscaler did not add replicas under load"
+
+
+def test_model_composition(rt):
+    """Deployments calling other deployments: nested binds become their own
+    deployments and the downstream receives a live DeploymentHandle
+    (reference: serve deployment graphs / handle passing)."""
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        def __call__(self, x):
+            return self.doubler.remote(x).result() + 1
+
+    handle = serve.run(Pipeline.bind(Doubler.bind()))
+    assert handle.remote(10).result() == 21
+    # Both nodes are live deployments with their own status entries.
+    st = serve.status()
+    assert "Pipeline" in st and "Doubler" in st
+
+
+def test_multiplexing(rt):
+    """Per-replica LRU of models keyed by the request's model id
+    (reference: serve/multiplex.py + handle.options(multiplexed_model_id))."""
+
+    @serve.deployment(num_replicas=2)
+    class Host:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model:{model_id}"
+
+        def __call__(self):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"model": model, "loads": list(self.loads)}
+
+    handle = serve.run(Host.bind())
+    r1 = handle.options(multiplexed_model_id="a").remote().result()
+    assert r1["model"] == "model:a"
+    # Same model id -> same replica, warm cache: loads don't grow.
+    r2 = handle.options(multiplexed_model_id="a").remote().result()
+    assert r2["loads"].count("a") == 1
+    # A different id loads separately (possibly on the other replica).
+    r3 = handle.options(multiplexed_model_id="b").remote().result()
+    assert r3["model"] == "model:b"
+
+
+def test_multiplex_lru_eviction(rt):
+    @serve.deployment(num_replicas=1)
+    class Host:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return model_id
+
+        def __call__(self):
+            mid = serve.get_multiplexed_model_id()
+            self.get_model(mid)
+            return list(self.loads)
+
+    handle = serve.run(Host.bind())
+    for mid in ("a", "b", "c", "a"):  # c evicts a (LRU size 2) -> a reloads
+        loads = handle.options(multiplexed_model_id=mid).remote().result()
+    assert loads == ["a", "b", "c", "a"]
