@@ -63,6 +63,11 @@ def _part_agg(source: Source, ops: List[Op], col: str, kind: str):
         return (arr.min(), len(arr))
     if kind == "max":
         return (arr.max(), len(arr))
+    if kind == "sumsq":
+        arr = arr.astype(np.float64)
+        return ((arr.sum(), (arr * arr).sum()), len(arr))
+    if kind == "unique":
+        return (np.unique(arr).tolist(), len(arr))
     raise ValueError(kind)
 
 
@@ -151,6 +156,32 @@ class GroupedDataset:
     def max(self, col: str) -> "Dataset":
         return self._run(col, "max")
 
+    def map_groups(self, fn: Callable[[Batch], Batch]) -> "Dataset":
+        """Apply ``fn`` to each group's batch (reference: grouped_data.py
+        map_groups — sorts by key, then applies the UDF per contiguous
+        group).  Single-task application after the sort; fine at the same
+        scale as Dataset.sort."""
+        key = self._key
+        sorted_ds = self._ds.sort(key)
+        refs, _ = sorted_ds._materialize_refs()
+
+        @ray_tpu.remote
+        def apply(refs: List[Any]) -> Block:
+            block = Block.concat([ray_tpu.get(r) for r in refs])
+            cols = block.to_numpy()
+            keys = cols[key]
+            pieces = []
+            lo = 0
+            for hi in builtins.range(1, len(keys) + 1):
+                if hi == len(keys) or keys[hi] != keys[lo]:
+                    group = {k: v[lo:hi] for k, v in cols.items()}
+                    out = fn(group)
+                    pieces.append(Block.from_batch(out))
+                    lo = hi
+            return Block.concat(pieces) if pieces else Block.from_batch({})
+
+        return Dataset([(apply.remote(refs), [])])
+
 
 @ray_tpu.remote
 def _gather_spans(spans: List[tuple]) -> Block:
@@ -182,6 +213,57 @@ def _write_parquet_task(source: Source, ops: List[Op], path: str) -> int:
         block = op(block)
     pq.write_table(block.to_arrow(), path)
     return block.num_rows
+
+
+@ray_tpu.remote
+def _write_csv_task(source: Source, ops: List[Op], path: str) -> int:
+    import pyarrow.csv as pacsv
+
+    block = source() if callable(source) else source
+    for op in ops:
+        block = op(block)
+    pacsv.write_csv(block.to_arrow(), path)
+    return block.num_rows
+
+
+@ray_tpu.remote
+def _write_json_task(source: Source, ops: List[Op], path: str) -> int:
+    """JSON-lines, one object per row (reference: data write_json emits
+    pandas-style JSONL files)."""
+    import json as _json
+
+    block = source() if callable(source) else source
+    for op in ops:
+        block = op(block)
+    def cell(v):
+        if isinstance(v, np.ndarray):
+            return v.tolist()  # tensor column: serialize as a nested list
+        return v.item() if hasattr(v, "item") else v
+
+    cols = block.to_numpy()
+    names = list(cols)
+    with open(path, "w") as f:
+        for i in builtins.range(block.num_rows):
+            f.write(_json.dumps({k: cell(cols[k][i]) for k in names}) + "\n")
+    return block.num_rows
+
+
+@ray_tpu.remote
+def _zip_spans(left_spans: List[tuple], right_spans: List[tuple]) -> Block:
+    """Column-wise join of two row-aligned span lists.  Duplicate column
+    names from the right side get a _1 suffix (reference: dataset.py zip
+    disambiguates with suffixes)."""
+    def gather(spans):
+        return Block.concat([
+            ray_tpu.get(r).slice(lo, hi) for r, lo, hi in spans
+        ])
+
+    left, right = gather(left_spans), gather(right_spans)
+    lcols, rcols = left.to_numpy(), right.to_numpy()
+    out = dict(lcols)
+    for k, v in rcols.items():
+        out[k if k not in out else f"{k}_1"] = v
+    return Block.from_batch(out)
 
 
 def _batch_op(fn, batch_format: str, fn_kwargs: Optional[dict]) -> Op:
@@ -363,6 +445,111 @@ class Dataset:
     def union(self, other: "Dataset") -> "Dataset":
         return Dataset(self._parts + other._parts)
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise join of two row-aligned datasets (reference:
+        dataset.py zip — counts must match; right-side duplicate column
+        names get a _1 suffix).  Output partitioning follows self's blocks;
+        right spans covering each left block are gathered per task."""
+        lrefs, lcounts = self._materialize_refs()
+        rrefs, rcounts = other._materialize_refs()
+        if sum(lcounts) != sum(rcounts):
+            raise ValueError(
+                f"zip requires equal row counts "
+                f"({sum(lcounts)} != {sum(rcounts)})"
+            )
+
+        def spans_for(lo: int, hi: int) -> List[tuple]:
+            """Right-side spans covering global rows [lo, hi)."""
+            out, pos = [], 0
+            for ref, cnt in builtins.zip(rrefs, rcounts):
+                start, end = pos, pos + cnt
+                pos = end
+                if end <= lo or start >= hi:
+                    continue
+                out.append((ref, max(lo - start, 0), min(hi, end) - start))
+            return out
+
+        parts, pos = [], 0
+        for ref, cnt in builtins.zip(lrefs, lcounts):
+            parts.append((
+                _zip_spans.remote([(ref, 0, cnt)], spans_for(pos, pos + cnt)),
+                [],
+            ))
+            pos += cnt
+        return Dataset(parts, list(lcounts))
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: dataset.py random_sample).
+        Seeded runs are reproducible for the same dataset; unseeded runs
+        draw a fresh base seed per call."""
+        if seed is not None:
+            base = seed
+        else:
+            import os as _os
+
+            base = int.from_bytes(_os.urandom(8), "little")
+
+        def op(block: Block) -> Block:
+            import zlib
+
+            n = block.num_rows
+            if n == 0:
+                return block
+            # Distinct stream per block: fold in a content fingerprint
+            # (first/last row of the first column) so equal-sized blocks
+            # don't replay identical in-block positions.
+            cols = block.to_numpy()
+            fp = 0
+            if cols:
+                first = next(iter(cols.values()))
+                fp = zlib.crc32(
+                    np.ascontiguousarray(first[:1]).tobytes()
+                    + np.ascontiguousarray(first[-1:]).tobytes()
+                )
+            rng = np.random.default_rng((base, n, fp))
+            return block.take_rows(np.flatnonzero(rng.random(n) < fraction))
+
+        return self._with_op(op)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column, computed as per-block partials on
+        the cluster (reference: dataset.py unique — only each block's
+        distinct set travels to the driver)."""
+        partials = [p for p in ray_tpu.get(
+            [_part_agg.remote(src, ops, column, "unique")
+             for src, ops in self._parts]
+        ) if p is not None]
+        seen: set = set()
+        for vals, _ in partials:
+            seen.update(vals)
+        return sorted(seen)
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None) -> List["Dataset"]:
+        """Split into (train, test) datasets (reference: dataset.py
+        train_test_split)."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        refs, counts = ds._materialize_refs()
+        total = sum(counts)
+        n_test = int(total * test_size)
+        n_train = total - n_test
+        train = Dataset([(r, []) for r in refs], counts).limit(n_train)
+        # Tail rows: skip n_train, keep the rest.
+        tail_parts, pos = [], 0
+        tail_counts = []
+        for ref, cnt in builtins.zip(refs, counts):
+            start, end = pos, pos + cnt
+            pos = end
+            if end <= n_train:
+                continue
+            lo = max(n_train - start, 0)
+            tail_parts.append((_gather_spans.remote([(ref, lo, cnt)]), []))
+            tail_counts.append(cnt - lo)
+        return [train, Dataset(tail_parts, tail_counts)]
+
     def limit(self, k: int) -> "Dataset":
         """First k rows (streams only as many parts as needed)."""
         taken: List[tuple] = []
@@ -515,6 +702,42 @@ class Dataset:
             return sum(vals)
         return min(vals) if kind == "min" else max(vals)
 
+    def show(self, limit: int = 20) -> None:
+        """Print the first ``limit`` rows (reference: dataset.py show)."""
+        for row in self.take(limit):
+            print(row)
+
+    def to_pandas(self, limit: Optional[int] = None):
+        """Materialize into one pandas DataFrame (reference: dataset.py
+        to_pandas — caps at a row limit to protect the driver)."""
+        import pandas as pd
+
+        ds = self.limit(limit) if limit is not None else self
+        frames = [
+            pd.DataFrame(block.to_numpy())
+            for block in ds.iter_blocks() if block.num_rows
+        ]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def std(self, col: str, ddof: int = 1):
+        """Column standard deviation via per-part (sum, sumsq, n) partials
+        (reference: dataset.py std — the same Welford-free formulation)."""
+        partials = [p for p in ray_tpu.get(
+            [_part_agg.remote(src, ops, col, "sumsq")
+             for src, ops in self._parts]
+        ) if p is not None]
+        if not partials:
+            return None
+        s = sum(v for (v, _), _ in partials)
+        ss = sum(v for (_, v), _ in partials)
+        n = sum(c for _, c in partials)
+        if n <= ddof:
+            return None
+        var = (ss - s * s / n) / (n - ddof)
+        return float(np.sqrt(max(var, 0.0)))
+
     def sum(self, col: str):
         return self._agg(col, "sum")
 
@@ -571,6 +794,28 @@ class Dataset:
         ray_tpu.get([
             _write_parquet_task.remote(
                 src, ops, os.path.join(path, f"part-{i:05d}.parquet")
+            )
+            for i, (src, ops) in enumerate(self._parts)
+        ])
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        ray_tpu.get([
+            _write_csv_task.remote(
+                src, ops, os.path.join(path, f"part-{i:05d}.csv")
+            )
+            for i, (src, ops) in enumerate(self._parts)
+        ])
+
+    def write_json(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        ray_tpu.get([
+            _write_json_task.remote(
+                src, ops, os.path.join(path, f"part-{i:05d}.json")
             )
             for i, (src, ops) in enumerate(self._parts)
         ])

@@ -13,6 +13,7 @@ import pytest
 
 import ray_tpu
 import ray_tpu.data as rtd
+from builtins import range as builtins_range
 from ray_tpu.data.block import Block
 
 
@@ -248,3 +249,142 @@ def test_groupby_aggregations(rt_shared):
     means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
     assert means[0] == (0 + 3 + 6 + 9) / 4
     assert {r["k"]: r["max(v)"] for r in ds.groupby("k").max("v").take_all()}[2] == 11.0
+
+
+def test_zip(rt):
+    import ray_tpu.data as rd
+
+    a = rd.range(10, override_num_blocks=3)
+    b = rd.from_items([{"sq": i * i} for i in builtins_range(10)],
+                      override_num_blocks=4)
+    z = a.zip(b)
+    rows = z.take_all()
+    assert len(rows) == 10
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+
+    # Duplicate column names get a _1 suffix.
+    z2 = a.zip(rd.range(10, override_num_blocks=2))
+    assert set(z2.schema()) == {"id", "id_1"}
+
+    with pytest.raises(ValueError, match="equal row counts"):
+        a.zip(rd.range(7))
+
+
+def test_random_sample_and_unique(rt):
+    import ray_tpu.data as rd
+
+    ds = rd.range(1000, override_num_blocks=4)
+    sampled = ds.random_sample(0.2, seed=0)
+    n = sampled.count()
+    assert 100 < n < 320  # loose Bernoulli bounds
+    assert sampled.unique("id") == sorted(
+        r["id"] for r in sampled.take_all()
+    )
+    ds2 = rd.from_items([{"k": v} for v in [3, 1, 3, 2, 1]])
+    assert ds2.unique("k") == [1, 2, 3]
+
+
+def test_train_test_split(rt):
+    import ray_tpu.data as rd
+
+    train, test = rd.range(100, override_num_blocks=5).train_test_split(0.25)
+    assert train.count() == 75 and test.count() == 25
+    got = sorted(r["id"] for r in train.take_all() + test.take_all())
+    assert got == list(builtins_range(100))
+
+
+def test_std_and_show(rt, capsys):
+    import ray_tpu.data as rd
+    import numpy as np
+
+    vals = [float(i) for i in builtins_range(50)]
+    ds = rd.from_items([{"x": v} for v in vals], override_num_blocks=4)
+    assert abs(ds.std("x") - np.std(vals, ddof=1)) < 1e-9
+    ds.show(3)
+    out = capsys.readouterr().out
+    assert out.count("{") == 3
+
+
+def test_to_pandas(rt):
+    import ray_tpu.data as rd
+
+    df = rd.range(20, override_num_blocks=3).to_pandas()
+    assert list(df["id"]) == list(builtins_range(20))
+    df5 = rd.range(20).to_pandas(limit=5)
+    assert len(df5) == 5
+
+
+def test_write_csv_json_round_trip(rt, tmp_path):
+    import ray_tpu.data as rd
+
+    ds = rd.from_items(
+        [{"a": i, "b": f"s{i}"} for i in builtins_range(12)],
+        override_num_blocks=3,
+    )
+    csv_dir = str(tmp_path / "csv_out")
+    json_dir = str(tmp_path / "json_out")
+    ds.write_csv(csv_dir)
+    ds.write_json(json_dir)
+    back_csv = rd.read_csv(csv_dir)
+    assert sorted(r["a"] for r in back_csv.take_all()) == list(builtins_range(12))
+    back_json = rd.read_json(json_dir)
+    rows = sorted(back_json.take_all(), key=lambda r: r["a"])
+    assert rows[3]["b"] == "s3"
+
+
+def test_map_groups(rt):
+    import ray_tpu.data as rd
+    import numpy as np
+
+    ds = rd.from_items(
+        [{"g": i % 3, "v": float(i)} for i in builtins_range(12)],
+        override_num_blocks=4,
+    )
+
+    def center(batch):
+        return {"g": batch["g"][:1], "v_mean": np.array([batch["v"].mean()])}
+
+    out = sorted(ds.groupby("g").map_groups(center).take_all(),
+                 key=lambda r: r["g"])
+    assert [r["g"] for r in out] == [0, 1, 2]
+    assert out[0]["v_mean"] == np.mean([0.0, 3.0, 6.0, 9.0])
+
+
+def test_random_sample_varies_across_blocks_and_calls(rt):
+    import ray_tpu.data as rd
+
+    ds = rd.range(1000, override_num_blocks=4)
+    ids = sorted(r["id"] for r in ds.random_sample(0.1, seed=7).take_all())
+    # Equal-sized blocks must not replay identical in-block positions
+    # (regression: the sample was 4 translated copies of one pattern).
+    base = [i for i in ids if i < 250]
+    translated = all(
+        sorted(i - off for i in ids if off <= i < off + 250) == base
+        for off in (250, 500, 750)
+    )
+    assert not translated, "per-block sample positions are identical"
+    # Unseeded calls draw fresh randomness.
+    a = ds.random_sample(0.2).take_all()
+    b = ds.random_sample(0.2).take_all()
+    assert [r["id"] for r in a] != [r["id"] for r in b]
+    # Seeded calls reproduce.
+    s1 = ds.random_sample(0.2, seed=3).take_all()
+    s2 = ds.random_sample(0.2, seed=3).take_all()
+    assert [r["id"] for r in s1] == [r["id"] for r in s2]
+
+
+def test_write_json_tensor_column(rt, tmp_path):
+    import json
+
+    import ray_tpu.data as rd
+
+    ds = rd.range_tensor(6, shape=(3,), override_num_blocks=2)
+    out = str(tmp_path / "tjson")
+    ds.write_json(out)
+    rows = []
+    for f in sorted(os.listdir(out)):
+        with open(os.path.join(out, f)) as fh:
+            rows += [json.loads(line) for line in fh]
+    assert len(rows) == 6
+    assert all(isinstance(r["data"], list) and len(r["data"]) == 3
+               for r in rows)
