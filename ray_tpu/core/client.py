@@ -9,6 +9,7 @@ worker_main.py.  One Client per process (driver or worker).
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -32,9 +33,18 @@ class Client:
         pid: int = 0,
         session: Optional[str] = None,
     ):
+        from . import schema as wire_schema
+
         host, port = head_addr.rsplit(":", 1)
         self.rpc = RpcClient(host, int(port), name=f"{kind}-rpc")
-        body: Dict[str, Any] = {"kind": kind, "pid": pid}
+        body: Dict[str, Any] = {
+            "kind": kind, "pid": pid,
+            "protocol": wire_schema.PROTOCOL_VERSION,
+        }
+        if kind == "driver" and os.environ.get("RT_FORCE_PROXY_DRIVER") == "1":
+            # Opt into the off-host proxy path explicitly (tests; also
+            # useful when the driver host has no usable /dev/shm).
+            body["force_proxy"] = True
         if worker_id is not None:
             body["worker_id"] = worker_id
         if node_id is not None:
@@ -48,6 +58,14 @@ class Client:
             NodeID(node_id) if node_id else
             (NodeID(reply["node_id"]) if reply.get("node_id") else None)
         )
+        # Proxy mode (off-host driver, the Ray Client role): no local shm
+        # attach — puts upload to the head, gets pull over TCP.  Pulled
+        # copies land in a private local session namespace so a same-host
+        # proxy (tests, RT_FORCE_PROXY_DRIVER) never clobbers the cluster
+        # session's segments.
+        self.proxy: bool = bool(reply.get("proxy"))
+        if self.proxy:
+            self.session = f"{self.session}-proxy{os.getpid()}"
         self.kind = kind
         self._stores: Dict[str, StoreClient] = {}
         # In-process store for small objects this process owns or has read
@@ -131,8 +149,20 @@ class Client:
             self._local_drop(oid)
             clean = True
             for st in self._stores.values():
+                had = oid in st._attached
                 if not st.detach(oid):
                     clean = False
+                elif had and self.proxy:
+                    # Proxy-pulled copies live in this process's private
+                    # session namespace: no node daemon owns the file, so
+                    # unlink it here or the driver host's shm grows without
+                    # bound.
+                    from .object_store import _seg_path
+
+                    try:
+                        os.unlink(_seg_path(st._session, oid))
+                    except OSError:
+                        pass
             if not clean:
                 dirty.append(raw)
         token = body.get("ack_token")
@@ -273,6 +303,25 @@ class Client:
                 n = len(self._put_batch)
             if n >= 64:
                 self._flush_put_batch()
+        elif self.proxy:
+            # Off-host driver: no local shm store the cluster can read —
+            # upload into the head's store in message-sized chunks
+            # (reference: util/client/dataclient.py chunked put stream).
+            blob = bytearray(size)
+            serialization.pack_into(meta, buffers, memoryview(blob))
+            chunk = 4 << 20
+            futs = []
+            for off in range(0, size, chunk):
+                part = bytes(blob[off:off + chunk])
+                futs.append(self.rpc.call_async("proxy_put", {
+                    "object_id": oid.binary(), "total": size,
+                    "offset": off, "data": part,
+                    "done": off + chunk >= size,
+                }))
+                while len(futs) > 4:
+                    futs.pop(0).result(timeout=120)
+            for f in futs:
+                f.result(timeout=120)
         else:
             # If this process freed large objects moments ago, their warm
             # segments are on their way to the pool (free -> detach-ack ->
@@ -400,6 +449,11 @@ class Client:
         if desc.get("inline") is not None:
             return serialization.unpack(desc["inline"])
         loc = desc.get("node_id")
+        if self.proxy:
+            # Off-host driver: every stored object is remote by definition;
+            # pull it over the owning node's object-plane endpoints.
+            view = self._pull_remote(oid, desc)
+            return serialization.unpack(view)
         if (loc is not None and self.node_id is not None
                 and loc != self.node_id.binary()):
             # The object lives on another node: fetch it over that node's
@@ -515,15 +569,17 @@ class Client:
         # the node's store daemon takes accounting ownership.  `from_pull`
         # lets the head reject (and reclaim) the copy if the object's last
         # reference was dropped mid-pull — resurrecting a freed record would
-        # leak the segment with no owner left to decref it.
-        try:
-            self.rpc.call(
-                "put_object",
-                {"object_id": oid.binary(), "size": size,
-                 "node_id": self.node_id.binary(), "from_pull": True},
-            )
-        except Exception:
-            pass
+        # leak the segment with no owner left to decref it.  Proxy drivers
+        # skip registration: their private copy is not a cluster location.
+        if self.node_id is not None:
+            try:
+                self.rpc.call(
+                    "put_object",
+                    {"object_id": oid.binary(), "size": size,
+                     "node_id": self.node_id.binary(), "from_pull": True},
+                )
+            except Exception:
+                pass
         return view
 
     def _bulk_conn(self, addr: str):

@@ -243,6 +243,11 @@ class Head:
         self.lineage: "OrderedDict[TaskID, dict]" = OrderedDict()
         self.reconstruction_counts: Dict[TaskID, int] = {}
         self.pg_waiters: Dict[PlacementGroupID, List[asyncio.Event]] = {}
+        self._proxy_uploads: Dict[ObjectID, Any] = {}
+        # Last per-node resource view from each daemon (the resource-syncer
+        # table — reference: ray_syncer.h:88; consumed by the state API and
+        # dashboard).
+        self.node_stats: Dict[NodeID, dict] = {}
         self._periodic_task: Optional[asyncio.Task] = None
         self._tick_task: Optional[asyncio.Task] = None
         self._shutdown = False
@@ -253,7 +258,7 @@ class Head:
             "register", "kv_put", "kv_get", "kv_del", "kv_keys",
             "submit_task", "create_actor", "submit_actor_task",
             "task_done", "stream_item", "metrics_report", "batch",
-            "put_object", "put_object_batch",
+            "put_object", "put_object_batch", "proxy_put",
             "get_objects",
             "wait_objects", "free_objects", "object_free_ack",
             "add_object_ref", "reconstruct_object",
@@ -264,9 +269,11 @@ class Head:
             "next_stream_item", "list_state", "ping", "shutdown_cluster",
             "actor_restarting", "restore_object", "store_stats",
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
-            "node_health_ack",
+            "node_health_ack", "node_stats",
         ]:
-            self.server.register(name, getattr(self, f"h_{name}"))
+            self.server.register(
+                name, _validated(name, getattr(self, f"h_{name}"))
+            )
         # The head serves chunked pulls for its own node's objects
         # (remote nodes serve theirs via their daemon's object-plane server).
         from .node_main import make_pull_handler
@@ -585,6 +592,13 @@ class Head:
         return {"ok": True, "session": self.session}
 
     async def h_register(self, conn, body):
+        from . import schema as wire_schema
+        from .rpc import RpcError
+
+        try:
+            wire_schema.check_protocol(body.get("protocol"))
+        except wire_schema.SchemaError as e:
+            raise RpcError(str(e)) from None
         kind = body["kind"]
         if kind == "worker":
             worker_id = WorkerID(body["worker_id"])
@@ -620,20 +634,22 @@ class Head:
             conn.meta["node_id"] = node_id
             self._kick()
             return {"session": self.session, "node_id": node_id.binary()}
-        # Drivers attach the HEAD node's shm session for zero-copy reads: a
-        # driver on another machine would mmap the wrong (or no) store, so
-        # reject it explicitly instead of corrupting location preferences
-        # (remote entrypoints go through job_submission / a cluster node).
+        # Drivers on the head host attach its shm session for zero-copy
+        # reads.  A driver on another machine gets PROXY mode instead (the
+        # Ray Client role — reference: python/ray/util/client/, ray_client
+        # .proto): no shm attach, no location preference; puts upload in
+        # chunks to the head's store (h_proxy_put) and gets pull over the
+        # object-plane TCP endpoints like any cross-node read.
         peer = conn.writer.get_extra_info("peername")
         peer_ip = peer[0] if peer else ""
         if peer_ip.startswith("::ffff:"):  # IPv4-mapped (dual-stack socket)
             peer_ip = peer_ip[len("::ffff:"):]
-        if peer_ip and peer_ip not in ("127.0.0.1", "::1", self.host):
-            raise ValueError(
-                f"driver connections must originate on the head host "
-                f"(got {peer[0]}); submit remote work via "
-                "ray_tpu.job_submission or run the driver on a cluster node"
-            )
+        remote = peer_ip and peer_ip not in ("127.0.0.1", "::1", self.host)
+        if remote or body.get("force_proxy"):
+            conn.meta["kind"] = kind  # driver (proxied)
+            conn.meta["pid"] = body.get("pid")
+            conn.meta["proxy"] = True
+            return {"session": self.session, "proxy": True}
         conn.meta["kind"] = kind  # driver
         conn.meta["pid"] = body.get("pid")
         conn.meta["reader_node"] = self.local_node_id
@@ -643,6 +659,15 @@ class Head:
         }
 
     async def _on_disconnect(self, conn: Connection):
+        # A proxy driver that died mid-upload leaves unsealed segments in
+        # the head store; reclaim them (gets on those ids keep blocking
+        # until their own timeouts, same as a never-sealed put).
+        for oid in conn.meta.pop("proxy_uploads", ()):  # type: ignore[misc]
+            self._proxy_uploads.pop(oid, None)
+            try:
+                self.store.free(oid, pool=False)
+            except Exception:
+                pass
         worker_id = self.conn_to_worker.pop(conn.conn_id, None)
         if conn.meta.get("pid") is not None:
             self.metrics_by_pid.pop(conn.meta["pid"], None)
@@ -661,6 +686,7 @@ class Head:
             self.node_object_addrs.pop(node_id, None)
             self.node_bulk_addrs.pop(node_id, None)
             self.node_last_ack.pop(node_id, None)
+            self.node_stats.pop(node_id, None)
             damaged = self.scheduler.remove_node(node_id)
             if damaged:
                 # Bundles lost with the node get re-placed on survivors
@@ -762,6 +788,38 @@ class Head:
         rec.sealed = True
         rec.ref_count = max(rec.ref_count, 1)
         self._notify_object_ready(oid)
+        return {}
+
+    async def h_proxy_put(self, conn, body):
+        """Chunked upload from a proxied (off-host) driver into the head's
+        store — the Ray Client put path (reference: util/client/dataclient.py
+        streams puts to the proxy server in chunks)."""
+        oid = ObjectID(body["object_id"])
+        total = body["total"]
+        view = self._proxy_uploads.get(oid)
+        if view is None:
+            view = self._proxy_uploads[oid] = self.store.create(oid, total)
+            # Track per connection: a proxy driver dying mid-upload must
+            # not leak the unsealed segment (cleaned in _on_disconnect).
+            conn.meta.setdefault("proxy_uploads", set()).add(oid)
+        data = body["data"]
+        off = body["offset"]
+        if len(data) >= (1 << 20):
+            from ray_tpu import _native
+
+            _native.copy(view[off:off + len(data)], data)
+        else:
+            view[off:off + len(data)] = data
+        if body.get("done"):
+            self._proxy_uploads.pop(oid, None)
+            conn.meta.get("proxy_uploads", set()).discard(oid)
+            self.store.seal(oid)
+            rec = self._obj(oid)
+            rec.size = total
+            rec.locations.add(self.local_node_id)
+            rec.sealed = True
+            rec.ref_count = max(rec.ref_count, 1)
+            self._notify_object_ready(oid)
         return {}
 
     # -- persistence (reference: redis_store_client.h — GCS tables survive a
@@ -948,7 +1006,10 @@ class Head:
         conns = [
             c for c in self.server.connections.values()
             if c.meta.get("kind") in ("driver", "worker")
-            and c.meta.get("reader_node") in locations
+            and (c.meta.get("reader_node") in locations
+                 # Proxy drivers have no node identity but may hold pulled
+                 # private copies of anything — always notify them.
+                 or c.meta.get("proxy"))
         ]
         if not conns:
             self._finalize_free(items, dirty=set())
@@ -1762,6 +1823,16 @@ class Head:
             w.last_ack = time.monotonic()
         return {}
 
+    async def h_node_stats(self, conn, body):
+        node_id = NodeID(body["node_id"])
+        self.node_stats[node_id] = {
+            "store": body.get("store"),
+            "load1": body.get("load1"),
+            "num_worker_procs": body.get("num_worker_procs"),
+            "time": time.time(),
+        }
+        return {}
+
     async def h_node_health_ack(self, conn, body):
         self.node_last_ack[NodeID(body["node_id"])] = time.monotonic()
         return {}
@@ -2225,7 +2296,8 @@ class Head:
                     (n.node_id, {"resources": n.total, "available": n.available,
                                  "alive": n.alive, "labels": n.labels,
                                  "pending_spawns":
-                                     self._spawn_pending.get(n.node_id, 0)})
+                                     self._spawn_pending.get(n.node_id, 0),
+                                 "stats": self.node_stats.get(n.node_id)})
                     for n in self.scheduler.nodes.values()
                 )
             ]}
@@ -2293,6 +2365,24 @@ class Head:
             lambda: asyncio.ensure_future(self.stop())
         )
         return {}
+
+
+def _validated(name: str, handler):
+    """Boundary validation: malformed control-plane messages answer with a
+    field-level error instead of a KeyError mid-handler (the protobuf-
+    schema role — see core/schema.py)."""
+    from . import schema as wire_schema
+    from .rpc import RpcError
+
+    async def wrapped(conn, body):
+        try:
+            wire_schema.validate(name, body)
+        except wire_schema.SchemaError as e:
+            raise RpcError(str(e)) from None
+        return await handler(conn, body)
+
+    wrapped.__name__ = f"validated_{name}"
+    return wrapped
 
 
 def env_jax_platform() -> str:

@@ -214,8 +214,11 @@ class NodeDaemon:
             ) if self.node_id else None,
         )
         self.head.on_connection_lost = lambda: os._exit(0)
+        from . import schema as wire_schema
+
         body = {
             "kind": "node",
+            "protocol": wire_schema.PROTOCOL_VERSION,
             "resources": self.resources,
             "labels": self.labels,
             "num_workers": self.num_workers,
@@ -315,6 +318,29 @@ class NodeDaemon:
 
     # ------------------------------------------------------------------ loop
 
+    def _report_stats(self):
+        """Push this node's resource view to the head: store pressure, host
+        load, live worker count (the resource-syncer role — reference:
+        src/ray/common/ray_syncer/ray_syncer.h:88 gossips per-node resource
+        views to the GCS over a bidi stream; here it rides the existing
+        daemon connection)."""
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            load1 = 0.0
+        stats = {
+            "node_id": self.node_id.binary(),
+            "store": self.store.stats(),
+            "load1": load1,
+            "num_worker_procs": (
+                len(self.worker_pids) + len(self.worker_procs)
+            ),
+        }
+        try:
+            self.head.call_async("node_stats", stats)
+        except Exception:
+            pass  # reporting is best-effort; liveness has its own path
+
     def run(self):
         ticks = 0
         while not self._shutdown.wait(timeout=0.2):
@@ -324,6 +350,7 @@ class NodeDaemon:
                 p.poll()
             ticks += 1
             if ticks % 10 == 0:
+                self._report_stats()
                 # Prune exited zygote-forked workers (orphans reaped by
                 # init): a stale pid could be recycled by an unrelated
                 # process and must never be signalled at shutdown.

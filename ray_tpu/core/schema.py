@@ -1,0 +1,110 @@
+"""Wire-schema versioning + message validation for the control plane.
+
+Role-equivalent to the reference's protobuf schemas (reference:
+src/ray/protobuf/*.proto — 22 files give every RPC a typed, versioned wire
+format).  This framework ships msgpack dicts for flexibility; this module
+supplies the two protections protobuf would have given:
+
+- **Protocol version handshake**: every `register` carries
+  ``PROTOCOL_VERSION``; the head rejects mismatched peers with a clear
+  error instead of failing later on a missing/renamed field (the analog of
+  a protobuf breaking-change guard).  Bump the version whenever a message's
+  required fields change incompatibly.
+- **Required-field validation**: the head validates the control-plane's
+  mutating messages at the boundary and answers malformed ones with a
+  field-level error, instead of a KeyError deep in a handler.
+
+Only *requests into the head* are validated — responses and pushes are
+produced by the head itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+PROTOCOL_VERSION = 1
+
+_BYTES = (bytes, bytearray)
+_NUM = (int, float)
+
+#: method -> ((field, allowed types | None for any), ...)
+REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
+    "register": (("kind", str),),
+    "submit_task": (
+        ("task_id", _BYTES),
+        ("func_key", (str, type(None))),
+        ("return_ids", list),
+    ),
+    "create_actor": (("actor_id", _BYTES), ("creation_task", dict)),
+    "submit_actor_task": (("task_id", _BYTES), ("actor_id", _BYTES)),
+    "task_done": (("task_id", _BYTES),),
+    "put_object": (("object_id", _BYTES),),
+    "put_object_batch": (("objects", list),),
+    "proxy_put": (("object_id", _BYTES), ("total", _NUM), ("offset", _NUM),
+                  ("data", _BYTES)),
+    "get_objects": (("object_ids", list),),
+    "wait_objects": (("object_ids", list),),
+    "free_objects": (("object_ids", list),),
+    "add_object_ref": (("object_ids", list),),
+    "reconstruct_object": (("object_id", _BYTES),),
+    "create_placement_group": (("pg_id", _BYTES), ("bundles", list)),
+    "remove_placement_group": (("pg_id", _BYTES),),
+    "kill_actor": (("actor_id", _BYTES),),
+    "cancel_task": (("task_id", _BYTES),),
+    "get_actor_by_name": (("name", str),),
+    "kv_put": (("key", str), ("value", _BYTES)),
+    "kv_get": (("key", str),),
+    "kv_del": (("key", str),),
+    "publish": (("topic", str),),
+    "subscribe": (("topic", str),),
+    "list_state": (("kind", str),),
+    "batch": (("entries", list),),
+    "stream_item": (("task_id", _BYTES), ("index", _NUM)),
+    "task_blocked": (("task_id", _BYTES),),
+    "task_unblocked": (("task_id", _BYTES),),
+    "node_health_ack": (("node_id", _BYTES),),
+    "node_stats": (("node_id", _BYTES),),
+    "restore_object": (("object_id", _BYTES),),
+}
+
+
+class SchemaError(Exception):
+    """Malformed control-plane message (missing/mistyped required field)."""
+
+
+def validate(method: str, body: Any) -> None:
+    """Raise SchemaError when ``body`` is missing required fields for
+    ``method``.  Unknown methods and extra fields pass — the schema guards
+    the floor, it does not freeze the ceiling (matching proto3's
+    unknown-field tolerance)."""
+    spec = REQUIRED.get(method)
+    if spec is None:
+        return
+    if not isinstance(body, dict):
+        raise SchemaError(
+            f"{method}: body must be a map, got {type(body).__name__}"
+        )
+    for field, types in spec:
+        if field not in body:
+            raise SchemaError(f"{method}: missing required field {field!r}")
+        if types is not None and not isinstance(body[field], types):
+            tn = getattr(types, "__name__", None) or "/".join(
+                t.__name__ for t in types
+            )
+            raise SchemaError(
+                f"{method}: field {field!r} must be {tn}, got "
+                f"{type(body[field]).__name__}"
+            )
+
+
+def check_protocol(peer_version: Any) -> None:
+    """Reject peers speaking a different protocol revision."""
+    if peer_version is None:
+        # Pre-handshake tooling (old CLI builds): tolerate, the field
+        # floor still validates individual messages.
+        return
+    if peer_version != PROTOCOL_VERSION:
+        raise SchemaError(
+            f"protocol version mismatch: peer speaks {peer_version}, this "
+            f"head speaks {PROTOCOL_VERSION}; upgrade the older side"
+        )

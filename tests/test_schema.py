@@ -1,0 +1,69 @@
+"""Wire-schema versioning + boundary validation.
+
+Reference analog: src/ray/protobuf/*.proto gives every RPC a typed wire
+format; here core/schema.py enforces a protocol handshake and required
+fields at the head boundary.
+"""
+
+import pytest
+
+from ray_tpu.core import schema
+from ray_tpu.core.rpc import RpcError
+
+
+class TestValidateUnit:
+    def test_valid_message_passes(self):
+        schema.validate("kv_put", {"key": "a", "value": b"1"})
+
+    def test_missing_field(self):
+        with pytest.raises(schema.SchemaError, match="missing required"):
+            schema.validate("kv_put", {"key": "a"})
+
+    def test_wrong_type(self):
+        with pytest.raises(schema.SchemaError, match="must be"):
+            schema.validate("kv_put", {"key": "a", "value": "not-bytes"})
+
+    def test_non_dict_body(self):
+        with pytest.raises(schema.SchemaError, match="must be a map"):
+            schema.validate("kv_put", ["key"])
+
+    def test_unknown_method_tolerated(self):
+        schema.validate("future_method", {"whatever": 1})
+
+    def test_extra_fields_tolerated(self):
+        schema.validate("kv_get", {"key": "a", "new_flag": True})
+
+    def test_protocol(self):
+        schema.check_protocol(schema.PROTOCOL_VERSION)
+        schema.check_protocol(None)  # legacy tooling floor
+        with pytest.raises(schema.SchemaError, match="mismatch"):
+            schema.check_protocol(schema.PROTOCOL_VERSION + 1)
+
+
+class TestBoundary:
+    def test_malformed_rpc_rejected_cleanly(self, rt_shared):
+        from ray_tpu.core.context import ctx
+
+        with pytest.raises(RpcError, match="missing required field"):
+            ctx.client.call("kv_put", {"key": "x"})  # no value
+
+        with pytest.raises(RpcError, match="must be"):
+            ctx.client.call("list_state", {"kind": 42})
+
+        # The cluster stays healthy after rejecting garbage.
+        ctx.client.kv_put("x", b"1")
+        assert ctx.client.kv_get("x") == b"1"
+
+    def test_protocol_mismatch_rejected(self, rt_shared):
+        import os
+
+        from ray_tpu.core.rpc import RpcClient
+
+        host, port = os.environ["RT_ADDRESS"].rsplit(":", 1)
+        rpc = RpcClient(host, int(port), name="old-peer")
+        try:
+            with pytest.raises(RpcError, match="protocol version mismatch"):
+                rpc.call("register", {"kind": "driver", "pid": 0,
+                                      "protocol": 999})
+        finally:
+            rpc.close()
