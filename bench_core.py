@@ -219,6 +219,28 @@ def bench_single_node(quick: bool):
     record("scheduling_throughput", n_tasks / (time.perf_counter() - t0),
            "tasks/s")
 
+    # -- compiled DAG: two-actor pipeline over shm channels, zero
+    # control-plane hops per call (reference: compiled_dag_node.py; no
+    # published per-call number, so vs_baseline is null).
+    from ray_tpu.dag import InputNode, enable_compiled_dags
+
+    @enable_compiled_dags
+    @ray_tpu.remote(max_concurrency=2)
+    class Stage:
+        def apply(self, x):
+            return x
+
+    s1, s2 = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        dag = s2.apply.bind(s1.apply.bind(inp)).experimental_compile()
+    try:
+        dag.execute(1)
+        timeit("compiled_dag_calls", lambda: dag.execute(1), min_time=mt)
+    finally:
+        dag.teardown()
+        for s in (s1, s2):
+            ray_tpu.kill(s)
+
 
 def bench_cross_node(quick: bool):
     """Cross-node object pull bandwidth through the node-daemon object plane."""

@@ -87,6 +87,7 @@ _load()
 if available:
     copy = _ext.copy
     prefault = _ext.prefault
+    wait_seq = _ext.wait_seq
 else:
     def copy(dest, src, nthreads: int = 0) -> int:  # type: ignore[misc]
         m = memoryview(src)
@@ -100,3 +101,17 @@ else:
 
     def prefault(dest, nthreads: int = 0) -> int:  # type: ignore[misc]
         return 0
+
+    def wait_seq(buf, timeout_s: float, want_unread: int) -> bool:  # type: ignore[misc]
+        import struct
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        mv = memoryview(buf)
+        while True:
+            w, r = struct.unpack_from("<QQ", mv, 0)
+            if (w > r) == bool(want_unread):
+                return True
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.0002)
