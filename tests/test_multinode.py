@@ -212,3 +212,24 @@ def test_placement_group_pending_until_node_joins(cluster):
     assert not pg.ready(timeout=0.3)
     cluster.add_node(num_cpus=4)
     assert pg.ready(timeout=30)
+
+
+def test_node_stats_sync_to_head(cluster):
+    """Node daemons gossip their resource view (store pressure, load,
+    worker count) to the head — the resource-syncer role (reference:
+    src/ray/common/ray_syncer/ray_syncer.h:88)."""
+    from ray_tpu.core.context import ctx
+
+    cluster.add_node(num_cpus=1)
+    deadline = time.monotonic() + 15
+    stats = None
+    while time.monotonic() < deadline:
+        nodes = ctx.client.call("list_state", {"kind": "nodes"})["items"]
+        with_stats = [n for n in nodes if n.get("stats")]
+        if with_stats:
+            stats = with_stats[0]["stats"]
+            break
+        time.sleep(0.3)
+    assert stats is not None, "no node reported stats within 15s"
+    assert "store" in stats and stats["store"] is not None
+    assert "load1" in stats
