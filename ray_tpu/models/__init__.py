@@ -17,11 +17,13 @@ from .llama import (
     lora_sharding_rules,
 )
 from .mlp import MLPConfig, mlp_apply, mlp_init
+from .moe import MoEConfig, moe_apply, moe_init, moe_loss, moe_sharding_rules
 from .train_state import TrainState, make_train_step
 
 __all__ = [
     "LlamaConfig", "llama_init", "llama_apply", "llama_loss",
     "llama_sharding_rules", "lora_init", "lora_merge", "lora_sharding_rules",
     "MLPConfig", "mlp_init", "mlp_apply",
+    "MoEConfig", "moe_init", "moe_apply", "moe_loss", "moe_sharding_rules",
     "TrainState", "make_train_step",
 ]

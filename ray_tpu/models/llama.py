@@ -275,14 +275,11 @@ def llama_loss(
     B, S, d = hidden.shape
     w = params["lm_head"]
 
+    from ..ops.losses import masked_nll
+
     def chunk_nll(h_c, tgt_c):
         logits = (h_c @ w).astype(jnp.float32)
-        mask = tgt_c != ignore_index
-        tgt = jnp.where(mask, tgt_c, 0)
-        logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-        nll = (logz - gold) * mask
-        return nll.sum(), mask.sum()
+        return masked_nll(logits, tgt_c, ignore_index)
 
     chunk = 256
     if S % chunk != 0:

@@ -1,0 +1,252 @@
+"""Mixture-of-Experts decoder (Mixtral-family), TPU-first with expert
+parallelism.
+
+The reference framework has no MoE/EP feature (SURVEY §2.4: expert parallel
+"absent as a framework feature") — this is a net-new, first-class TPU
+capability, like sequence parallelism: the `ep` mesh axis shards the expert
+dimension, and the dispatch/combine einsums against one-hot routing masks
+let XLA insert the all_to_all collectives (the GShard/Switch formulation —
+hand-rolled NCCL alltoall is exactly what a TPU build must NOT do).
+
+Design (token-choice top-k with capacity):
+- router: logits [.., E]; top-k experts per token, probabilities renormalized
+- dispatch: one-hot [G, E, C] mask (G tokens/group, C capacity slots);
+  expert inputs gather to [E, C, d] — a single einsum, MXU-friendly
+- experts: batched SwiGLU over the leading E dim ([E, C, d] @ [E, d, f]),
+  sharded P(ep, ...) so each ep shard computes only its experts
+- combine: weighted einsum back to [G, d]; tokens over capacity are dropped
+  (their residual path carries them — standard Switch behavior)
+- aux loss: Switch load-balancing loss (mean expert fraction x mean router
+  probability x E), returned separately so the trainer can weight it.
+
+`n_experts=1, top_k=1` with ample capacity reduces exactly to the dense
+SwiGLU MLP — the correctness anchor used in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.norms import rms_norm
+from ..ops.rotary import rope_frequencies
+from ..parallel.mesh import AXIS_EP, AXIS_FSDP, AXIS_TP
+from ..parallel.sharding import ShardingRules
+from .llama import LlamaConfig, _attention, llama_sharding_rules
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Mixtral-style: Llama attention + MoE FFN every layer."""
+
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    aux_loss_coeff: float = 0.01
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert slot count for a group of ``n_tokens``."""
+        c = math.ceil(n_tokens * self.top_k * self.capacity_factor
+                      / self.n_experts)
+        return max(4, int(c))
+
+    def param_count(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        kv = self.n_kv_heads * self.head_dim
+        per_layer = (
+            d * d + 2 * d * kv + d * d          # attention
+            + d * self.n_experts                 # router
+            + self.n_experts * 3 * d * f         # experts
+            + 2 * d
+        )
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    def as_llama(self) -> LlamaConfig:
+        """Attention-config view (reuses the Llama attention path)."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, d_ff=self.d_ff,
+            max_seq=self.max_seq, rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps, dtype=self.dtype,
+        )
+
+    # ---- stock sizes ------------------------------------------------------
+
+    @staticmethod
+    def mixtral_8x7b(**kw) -> "MoEConfig":
+        return MoEConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "MoEConfig":
+        kw.setdefault("vocab_size", 512)
+        return MoEConfig(d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                         d_ff=256, n_experts=4, top_k=2, max_seq=256, **kw)
+
+
+def moe_init(config: MoEConfig, key: jax.Array) -> Params:
+    d, f, E = config.d_model, config.d_ff, config.n_experts
+    hd = config.head_dim
+    kv_out = config.n_kv_heads * hd
+    std = d ** -0.5
+    keys = jax.random.split(key, 2 + config.n_layers)
+
+    def dense(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            config.dtype
+        )
+
+    params: Params = {
+        "embed": dense(keys[0], (config.vocab_size, d), 1.0),
+        "final_norm": jnp.ones((d,), config.dtype),
+        "lm_head": dense(keys[1], (d, config.vocab_size), std),
+        "layers": [],
+    }
+    for i in range(config.n_layers):
+        ks = jax.random.split(keys[2 + i], 8)
+        params["layers"].append({
+            "attn_norm": jnp.ones((d,), config.dtype),
+            "attn": {
+                "wq": dense(ks[0], (d, d), std),
+                "wk": dense(ks[1], (d, kv_out), std),
+                "wv": dense(ks[2], (d, kv_out), std),
+                "wo": dense(ks[3], (d, d), std),
+            },
+            "moe_norm": jnp.ones((d,), config.dtype),
+            "moe": {
+                # Router in fp32: tiny, and top-k boundaries are precision
+                # sensitive.
+                "router": jax.random.normal(ks[4], (d, E), jnp.float32) * std,
+                "w1": dense(ks[5], (E, d, f), std),
+                "w3": dense(ks[6], (E, d, f), std),
+                "w2": dense(ks[7], (E, f, d), f ** -0.5),
+            },
+        })
+    return params
+
+
+def moe_sharding_rules() -> ShardingRules:
+    """Llama rules + expert weights sharded over (ep, fsdp, tp): each ep
+    shard owns E/ep experts; within an expert the FFN shards like megatron.
+    The router is tiny and replicated."""
+    base = llama_sharding_rules().rules
+    return ShardingRules([
+        (r"moe/router", P()),
+        (r"moe/(w1|w3)", P(AXIS_EP, AXIS_FSDP, AXIS_TP)),
+        (r"moe/w2", P(AXIS_EP, AXIS_TP, AXIS_FSDP)),
+        *base,
+    ])
+
+
+def _moe_ffn(config: MoEConfig, moe: Params, x: jax.Array
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k expert FFN over [B, S, d].  Returns (out, aux_loss)."""
+    B, S, d = x.shape
+    E, k = config.n_experts, config.top_k
+    G = B * S
+    C = config.capacity(G)
+    xf = x.reshape(G, d)
+
+    logits = (xf.astype(jnp.float32) @ moe["router"])          # [G, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, k)                     # [G, k]
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    # Capacity assignment: for each (expert, slot) pair, position of this
+    # token among the expert's claimants in token order (GShard's
+    # position_in_expert via masked cumsum).
+    onehot = jax.nn.one_hot(top_e, E, dtype=jnp.float32)       # [G, k, E]
+    # priority: earlier k-choices claim slots first, then token order.
+    flat = onehot.transpose(1, 0, 2).reshape(k * G, E)         # [k*G, E]
+    pos = jnp.cumsum(flat, axis=0) - flat                      # claim index
+    keep = (pos < C) * flat
+    slot = pos.reshape(k, G, E).transpose(1, 0, 2)             # [G, k, E]
+    keep = keep.reshape(k, G, E).transpose(1, 0, 2)
+
+    # dispatch[G, E, C]: token -> (expert, slot) one-hot (dropped tokens all
+    # zero); combine adds the renormalized router weight.
+    slot_oh = jax.nn.one_hot(
+        slot.astype(jnp.int32), C, dtype=jnp.float32
+    ) * keep[..., None]
+    dispatch = slot_oh.sum(1)                                  # [G, E, C]
+    combine = jnp.einsum("gk,gkec->gec", top_p, slot_oh)       # [G, E, C]
+
+    expert_in = jnp.einsum(
+        "gec,gd->ecd", dispatch.astype(config.dtype), xf
+    )                                                          # [E, C, d]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, moe["w1"]))
+    h = h * jnp.einsum("ecd,edf->ecf", expert_in, moe["w3"])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, moe["w2"])      # [E, C, d]
+
+    out = jnp.einsum(
+        "gec,ecd->gd", combine.astype(config.dtype), expert_out
+    )
+
+    # Switch load-balancing loss: E * sum_e f_e * P_e, where f_e is the
+    # fraction of tokens whose TOP-1 choice is e and P_e the mean router
+    # probability for e.
+    top1 = jax.nn.one_hot(top_e[:, 0], E, dtype=jnp.float32)
+    frac_tokens = top1.mean(0)
+    frac_prob = probs.mean(0)
+    aux = E * jnp.sum(frac_tokens * frac_prob)
+    return out.reshape(B, S, d), aux
+
+
+def _moe_block(config: MoEConfig, x, layer, cos, sin):
+    lconf = config.as_llama()
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    x = x + _attention(lconf, h, layer, cos, sin)
+    h = rms_norm(x, layer["moe_norm"], config.norm_eps)
+    ffn, aux = _moe_ffn(config, layer["moe"], h)
+    return x + ffn, aux
+
+
+def moe_apply(config: MoEConfig, params: Params, tokens: jax.Array
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Returns (logits [B, S, vocab] fp32, aux_loss scalar)."""
+    x = params["embed"][tokens].astype(config.dtype)
+    cos, sin = rope_frequencies(
+        config.head_dim, config.max_seq, config.rope_theta
+    )
+    block = _moe_block
+    if config.remat:
+        block = jax.checkpoint(_moe_block, static_argnums=(0,))
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x, aux = block(config, x, layer, cos, sin)
+        aux_total = aux_total + aux
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, aux_total / max(config.n_layers, 1)
+
+
+def moe_loss(config: MoEConfig, params: Params, tokens: jax.Array,
+             targets: jax.Array, ignore_index: int = -100) -> jax.Array:
+    """LM cross entropy + weighted load-balancing aux loss."""
+    from ..ops.losses import masked_cross_entropy
+
+    logits, aux = moe_apply(config, params, tokens)
+    nll = masked_cross_entropy(logits, targets, ignore_index)
+    return nll + config.aux_loss_coeff * aux

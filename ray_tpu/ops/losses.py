@@ -1,0 +1,36 @@
+"""Shared loss primitives.
+
+One masked-NLL implementation for every LM loss in the model zoo (llama's
+chunked-vocab CE, the MoE loss, the pipeline-parallel loss) — the
+``ignore_index`` masking and logsumexp algebra must not drift between them.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_nll(logits: jax.Array, targets: jax.Array,
+               ignore_index: int = -100) -> Tuple[jax.Array, jax.Array]:
+    """Summed token NLL over non-ignored positions.
+
+    ``logits`` [..., V] (use fp32 for the reduction), ``targets`` [...]
+    int.  Returns (nll_sum, token_count) so callers can combine across
+    chunks/microbatches before dividing.
+    """
+    mask = targets != ignore_index
+    tgt = jnp.where(mask, targets, 0)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    return nll.sum(), mask.sum()
+
+
+def masked_cross_entropy(logits: jax.Array, targets: jax.Array,
+                         ignore_index: int = -100) -> jax.Array:
+    """Mean token NLL (the common single-shot form of `masked_nll`)."""
+    total, count = masked_nll(logits, targets, ignore_index)
+    return total / jnp.maximum(count, 1)

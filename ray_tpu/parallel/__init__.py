@@ -13,6 +13,7 @@ from .mesh import (
     AXIS_DP,
     AXIS_EP,
     AXIS_FSDP,
+    AXIS_PP,
     AXIS_SP,
     AXIS_TP,
     MeshConfig,
@@ -20,6 +21,7 @@ from .mesh import (
     data_sharding,
     make_mesh,
 )
+from .pipeline import make_pp_loss, stack_layers, unstack_layers
 from .sharding import (
     ShardingRules,
     infer_param_specs,
@@ -30,8 +32,9 @@ from .sharding import (
 from .distributed import initialize_process_group, process_group_barrier
 
 __all__ = [
-    "AXIS_DP", "AXIS_FSDP", "AXIS_TP", "AXIS_SP", "AXIS_EP",
+    "AXIS_DP", "AXIS_FSDP", "AXIS_TP", "AXIS_SP", "AXIS_EP", "AXIS_PP",
     "MeshConfig", "make_mesh", "batch_spec", "data_sharding",
+    "make_pp_loss", "stack_layers", "unstack_layers",
     "ShardingRules", "infer_param_specs", "named_sharding", "shard_pytree",
     "with_sharding_constraint",
     "initialize_process_group", "process_group_barrier",

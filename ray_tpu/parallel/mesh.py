@@ -28,10 +28,12 @@ AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
 AXIS_EP = "ep"
+AXIS_PP = "pp"
 
-# Canonical axis order: dp outermost (cheapest to cross DCN), tp/sp innermost
-# (highest-bandwidth ICI neighbors).
-MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP)
+# Canonical axis order: dp outermost (cheapest to cross DCN), tp/sp/ep/pp
+# innermost (highest-bandwidth ICI neighbors — ep's all_to_all and pp's
+# stage-to-stage ppermute both want ICI adjacency).
+MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_EP, AXIS_PP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,9 +45,12 @@ class MeshConfig:
     fsdp: int = -1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                 "sp": self.sp, "ep": self.ep, "pp": self.pp}
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError("at most one mesh axis may be -1")

@@ -257,18 +257,18 @@ class TestNormsRotary:
 class TestMeshSharding:
     def test_mesh_resolve(self):
         assert MeshConfig(fsdp=-1).resolve(8) == {
-            "dp": 1, "fsdp": 8, "tp": 1, "sp": 1
+            "dp": 1, "fsdp": 8, "tp": 1, "sp": 1, "ep": 1, "pp": 1
         }
         assert MeshConfig(dp=2, fsdp=-1, tp=2).resolve(8) == {
-            "dp": 2, "fsdp": 2, "tp": 2, "sp": 1
+            "dp": 2, "fsdp": 2, "tp": 2, "sp": 1, "ep": 1, "pp": 1
         }
         with pytest.raises(ValueError):
             MeshConfig(dp=3).resolve(8)
 
     def test_make_mesh(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-        assert mesh.devices.shape == (2, 2, 2, 1)
-        assert mesh.axis_names == ("dp", "fsdp", "tp", "sp")
+        assert mesh.devices.shape == (2, 2, 2, 1, 1, 1)
+        assert mesh.axis_names == ("dp", "fsdp", "tp", "sp", "ep", "pp")
 
     def test_sharding_rules(self):
         from ray_tpu.parallel import ShardingRules

@@ -76,7 +76,8 @@ def test_mesh_reaches_session_and_gang_allreduces(rt, tmp_path):
     assert result.error is None
     # Each worker's sum(0..7) == 28; the gang allreduce doubles it.
     assert result.metrics["total"] == 56.0
-    assert result.metrics["mesh"] == {"dp": 1, "fsdp": 2, "tp": 2, "sp": 1}
+    assert result.metrics["mesh"] == {"dp": 1, "fsdp": 2, "tp": 2,
+                                      "sp": 1, "ep": 1, "pp": 1}
 
 
 def test_mesh_none_without_config(rt, tmp_path):
