@@ -63,6 +63,12 @@ class Config:
     # fork unboundedly (reference: worker_pool.h maximum_startup_concurrency
     # bounds concurrent startup).
     worker_pool_hard_cap_multiple: int = 4
+    # -- memory pressure --------------------------------------------------------
+    # Kill a worker when its node's host memory usage crosses this fraction
+    # (reference: src/ray/common/memory_monitor.h:52 MemoryMonitor +
+    # raylet/worker_killing_policy_group_by_owner.h).  Victims: retriable
+    # leased tasks first, newest first; their tasks retry.  0 disables.
+    memory_usage_threshold: float = 0.95
     # -- fault tolerance ------------------------------------------------------
     default_task_max_retries: int = 3
     # Finished task specs kept for object lineage reconstruction (their args
@@ -127,3 +133,20 @@ def get_config() -> Config:
 def set_config(cfg: Config) -> None:
     global _global_config
     _global_config = cfg
+
+
+def host_memory_used_frac() -> float:
+    """This host's memory pressure from /proc/meminfo (the MemoryMonitor
+    input — reference: src/ray/common/memory_monitor.h:52 reads the same
+    kernel counters)."""
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, _, rest = line.partition(":")
+                info[key] = int(rest.strip().split()[0])
+        total = info["MemTotal"]
+        avail = info.get("MemAvailable", total)
+        return 1.0 - avail / total
+    except (OSError, KeyError, ValueError, ZeroDivisionError):
+        return 0.0

@@ -248,6 +248,14 @@ class Head:
         # table — reference: ray_syncer.h:88; consumed by the state API and
         # dashboard).
         self.node_stats: Dict[NodeID, dict] = {}
+        # Workers killed by the memory monitor: their tasks' failure message
+        # names the cause (reference: worker_killing_policy*.h attributes
+        # OOM kills in the task error).  Ordered so the bound evicts oldest.
+        self._oom_kills: "OrderedDict[WorkerID, float]" = OrderedDict()
+        # Per-node kill cooldown: remote stats refresh every ~2s while this
+        # check runs every tick — without the cooldown one stale reading
+        # would kill a worker per tick.
+        self._last_oom_kill: Dict[NodeID, float] = {}
         self._periodic_task: Optional[asyncio.Task] = None
         self._tick_task: Optional[asyncio.Task] = None
         self._shutdown = False
@@ -458,12 +466,75 @@ class Head:
                         requeued = True
                 if requeued:
                     self._kick()
+                await self._check_memory_pressure()
             except asyncio.CancelledError:
                 return
             except Exception:
                 import traceback
 
                 traceback.print_exc()
+
+    # -- memory monitor (reference: src/ray/common/memory_monitor.h:52 +
+    # raylet/worker_killing_policy_group_by_owner.h) -------------------------
+
+    def _pick_oom_victim(self, node_id: NodeID) -> Optional[WorkerState]:
+        """Retriable leased tasks first, newest first; actors and
+        non-retriable work only as a last resort never — killing state-
+        bearing actors trades a recoverable stall for data loss
+        (reference: worker_killing_policy_group_by_owner.h prefers
+        retriable tasks, LIFO)."""
+        candidates = []
+        for w in self.workers.values():
+            if w.node_id != node_id or w.state != LEASED or not w.inflight:
+                continue
+            task = self.tasks.get(next(iter(w.inflight)))
+            if task is None:
+                continue
+            retriable = task.retries_left != 0
+            candidates.append((retriable, task.start_time, w))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: (not c[0], -c[1]))
+        return candidates[0][2]
+
+    async def _check_memory_pressure(self):
+        thr = self.config.memory_usage_threshold
+        if not thr:
+            return
+        for node_id in list(self.scheduler.nodes):
+            if node_id == self.local_node_id:
+                from .config import host_memory_used_frac
+
+                frac = host_memory_used_frac()
+            else:
+                st = self.node_stats.get(node_id) or {}
+                frac = st.get("mem_used_frac") or 0.0
+            if frac < thr:
+                continue
+            now = time.monotonic()
+            if now - self._last_oom_kill.get(node_id, 0.0) < 5.0:
+                continue  # let the last kill take effect / stats refresh
+            victim = self._pick_oom_victim(node_id)
+            if victim is None:
+                continue
+            self._last_oom_kill[node_id] = now
+            self._event("oom_kill", worker=victim.worker_id.hex(),
+                        mem_used_frac=round(frac, 4))
+            self._oom_kills[victim.worker_id] = now
+            while len(self._oom_kills) > 1000:  # bound: evict oldest
+                self._oom_kills.popitem(last=False)
+            if victim.node_id == self.local_node_id:
+                try:
+                    os.kill(victim.pid, 9)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            else:
+                daemon = self.node_daemons.get(victim.node_id)
+                if daemon is not None:
+                    try:
+                        await daemon.push("kill_worker", {"pid": victim.pid})
+                    except Exception:
+                        pass
 
     async def stop(self):
         try:
@@ -1084,9 +1155,17 @@ class Head:
         if rec.inline is not None:
             return {"inline": rec.inline}
         # Prefer a copy on the reader's own node (shm attach, zero-copy);
-        # otherwise any live location, served over its node's pull endpoint.
+        # otherwise a RANDOM live location: each completed pull registers a
+        # new replica (from_pull), so a hot object's readers fan out across
+        # replicas and a broadcast forms an organic distribution tree
+        # instead of hammering the origin node (reference:
+        # object_manager.h:125-139 spreads pulls over known locations).
         if prefer is not None and prefer in rec.locations:
             loc = prefer
+        elif len(rec.locations) > 1:
+            import random as _random
+
+            loc = _random.choice(list(rec.locations))
         else:
             loc = next(iter(rec.locations), None)
         return {
@@ -1828,6 +1907,7 @@ class Head:
         self.node_stats[node_id] = {
             "store": body.get("store"),
             "load1": body.get("load1"),
+            "mem_used_frac": body.get("mem_used_frac"),
             "num_worker_procs": body.get("num_worker_procs"),
             "time": time.time(),
         }
@@ -2053,6 +2133,7 @@ class Head:
         if worker is None:
             return
         worker.state = DEAD
+        oom_killed = self._oom_kills.pop(worker_id, None) is not None
         self.node_worker_counts[worker.node_id] = max(
             0, self.node_worker_counts.get(worker.node_id, 1) - 1
         )
@@ -2110,9 +2191,15 @@ class Head:
                 self.queued_tasks.append(task)
             else:
                 task.state = FAILED
+                cause = (
+                    " (killed by the memory monitor: host memory usage "
+                    "crossed memory_usage_threshold)"
+                    if oom_killed else ""
+                )
                 err = serialization.pack(
                     WorkerCrashedError(
-                        f"worker {worker_id.hex()[:8]} died while running task"
+                        f"worker {worker_id.hex()[:8]} died while running "
+                        f"task{cause}"
                     )
                 )
                 for raw in task.spec.get("return_ids", []):

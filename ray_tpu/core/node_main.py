@@ -328,10 +328,13 @@ class NodeDaemon:
             load1 = os.getloadavg()[0]
         except OSError:
             load1 = 0.0
+        from .config import host_memory_used_frac
+
         stats = {
             "node_id": self.node_id.binary(),
             "store": self.store.stats(),
             "load1": load1,
+            "mem_used_frac": host_memory_used_frac(),
             "num_worker_procs": (
                 len(self.worker_pids) + len(self.worker_procs)
             ),

@@ -1,0 +1,97 @@
+"""Memory-monitor worker killing.
+
+Reference analog: src/ray/common/memory_monitor.h:52 MemoryMonitor +
+raylet/worker_killing_policy_group_by_owner.h (retriable-first LIFO victim
+selection, OOM cause attributed in the task error).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def test_victim_selection_prefers_retriable_newest():
+    from ray_tpu.core.head import LEASED, Head, TaskRecord, WorkerState
+    from ray_tpu.core.ids import NodeID, TaskID, WorkerID
+
+    head = Head.__new__(Head)  # policy unit: no runtime needed
+    node = NodeID.from_random()
+    head.workers = {}
+    head.tasks = {}
+
+    def add(name, retries, start, state=LEASED):
+        tid = TaskID.from_random()
+        task = TaskRecord.__new__(TaskRecord)
+        task.spec = {"task_id": tid.binary(), "name": name}
+        task.task_id = tid
+        task.retries_left = retries
+        task.start_time = start
+        head.tasks[tid] = task
+        w = WorkerState(WorkerID.from_random(), node, conn=None, pid=0)
+        w.state = state
+        w.inflight = {tid}
+        head.workers[w.worker_id] = w
+        return w
+
+    old_retriable = add("old_retriable", 2, 100.0)
+    new_retriable = add("new_retriable", 2, 200.0)
+    newest_final = add("newest_final", 0, 300.0)
+
+    victim = head._pick_oom_victim(node)
+    # Retriable beats non-retriable even though the final task is newest;
+    # among retriables the newest goes first.
+    assert victim is new_retriable
+    assert victim is not newest_final and victim is not old_retriable
+
+
+def test_oom_kill_attributes_cause(monkeypatch):
+    """With the threshold forced below current usage, a non-retriable
+    leased task is killed and its error names the memory monitor."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, system_config={
+        "memory_usage_threshold": 0.0001,   # any host usage trips it
+        "health_check_period_s": 0.2,
+        "default_task_max_retries": 0,
+    })
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def sleeper():
+            time.sleep(30)
+            return 1
+
+        ref = sleeper.remote()
+        with pytest.raises(exceptions.WorkerCrashedError,
+                           match="memory monitor"):
+            ray_tpu.get(ref, timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_kill_retries_retriable_tasks():
+    """A retriable victim's task retries instead of failing (the monitor
+    kills it again each period until retries exhaust)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, system_config={
+        "memory_usage_threshold": 0.0001,
+        "health_check_period_s": 0.2,
+    })
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def sleeper():
+            time.sleep(30)
+
+        ref = sleeper.remote()
+        t0 = time.monotonic()
+        with pytest.raises(exceptions.WorkerCrashedError,
+                           match="memory monitor"):
+            ray_tpu.get(ref, timeout=60)
+        # Three attempts (initial + 2 retries), each killed by a periodic
+        # pass, must take at least two periods.
+        assert time.monotonic() - t0 > 0.4
+    finally:
+        ray_tpu.shutdown()
