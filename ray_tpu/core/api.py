@@ -412,21 +412,64 @@ def _process_runtime_env(renv: Optional[dict], cache: Optional[dict] = None):
     rewrite the env to reference them.  `cache` memoizes the expensive zip
     across calls, but the kv upload is re-ensured per client so a
     shutdown()+init() cycle re-populates the new cluster's KV (reference:
-    _private/runtime_env/working_dir.py URI-cached packages)."""
-    if not renv or "working_dir" not in renv:
+    _private/runtime_env/working_dir.py URI-cached packages;
+    runtime_env/py_modules.py ships import roots the same way)."""
+    if not renv or ("working_dir" not in renv and "py_modules" not in renv):
         return renv
-    if cache is not None and "key" in cache:
-        key, blob = cache["key"], cache["blob"]
-    else:
-        key, blob = _package_working_dir(renv["working_dir"])
-        if cache is not None:
-            cache["key"], cache["blob"] = key, blob
-    if key not in ctx.client.exported_keys:
-        ctx.client.kv_put(key, blob, overwrite=False)
-        ctx.client.exported_keys.add(key)
+    cache = cache if cache is not None else {}
     out = dict(renv)
-    out.pop("working_dir")
-    out["working_dir_key"] = key
+
+    def ensure(key, blob):
+        if key not in ctx.client.exported_keys:
+            ctx.client.kv_put(key, blob, overwrite=False)
+            ctx.client.exported_keys.add(key)
+
+    if "working_dir" in renv:
+        if "key" in cache:
+            key, blob = cache["key"], cache["blob"]
+        else:
+            key, blob = _package_working_dir(renv["working_dir"])
+            cache["key"], cache["blob"] = key, blob
+        ensure(key, blob)
+        out.pop("working_dir")
+        out["working_dir_key"] = key
+    if "py_modules" in renv:
+        # Each entry is a module DIRECTORY (or a module object); the worker
+        # extracts it under an import root on sys.path (reference:
+        # runtime_env/py_modules.py upload_py_modules_if_needed).
+        mod_keys = cache.get("py_module_keys")
+        if mod_keys is None:
+            mod_keys = []
+            for mod in renv["py_modules"]:
+                path = getattr(mod, "__path__", None)
+                if path is not None:
+                    mod_dir = list(path)[0]
+                elif isinstance(mod, str):
+                    mod_dir = mod
+                else:
+                    raise TypeError(
+                        "py_modules entries must be package directories "
+                        f"(str) or package module objects, got {mod!r} "
+                        "(single-file modules: ship their parent directory)"
+                    )
+                if not os.path.isdir(mod_dir):
+                    raise ValueError(
+                        f"py_modules entry {mod_dir!r} is not a directory"
+                    )
+                name = os.path.basename(mod_dir.rstrip("/"))
+                if ":" in name:
+                    raise ValueError(
+                        f"py_modules directory name {name!r} may not "
+                        "contain ':'"
+                    )
+                key, blob = _package_working_dir(mod_dir)
+                key = f"pymod:{name}:{key.split(':', 1)[1]}"
+                mod_keys.append((key, blob))
+            cache["py_module_keys"] = mod_keys
+        for key, blob in mod_keys:
+            ensure(key, blob)
+        out.pop("py_modules")
+        out["py_module_keys"] = [k for k, _ in mod_keys]
     return out
 
 
@@ -436,6 +479,22 @@ _VALID_OPTIONS = {
     "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
     "namespace", "memory", "_metadata",
 }
+
+
+def _inject_trace(spec: dict) -> None:
+    """Propagate the active trace context into an outgoing task spec
+    (reference: tracing_helper.py _DictPropagator injects the OTel span
+    context into the spec's serialized runtime context).  The pre-assigned
+    task_span_id makes the execution span's identity stable across retries."""
+    from ray_tpu.util import tracing
+
+    parent = tracing.context_for_submit()
+    if parent is not None:
+        spec["trace_ctx"] = {
+            "trace_id": parent["trace_id"],
+            "span_id": parent["span_id"],
+            "task_span_id": tracing._new_id(),
+        }
 
 
 def _resources_from_options(o: dict, default_cpu: float = 1.0) -> Dict[str, float]:
@@ -513,6 +572,7 @@ class RemoteFunction:
             "retry_exceptions": bool(o.get("retry_exceptions", False)),
             "runtime_env": self._renv(),
         }
+        _inject_trace(spec)
         # Submission is pipelined AND batched: the ref returns immediately
         # and bursts coalesce into one head RPC (reference: task submission
         # is async; errors surface on ray.get of the returned ref).
@@ -591,6 +651,7 @@ class ActorHandle:
             "return_ids": [r.binary() for r in return_ids],
             "max_retries": self._max_task_retries,
         }
+        _inject_trace(spec)
         ctx.client.call_batched("submit_actor_task", spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())

@@ -277,7 +277,7 @@ class Head:
             "next_stream_item", "list_state", "ping", "shutdown_cluster",
             "actor_restarting", "restore_object", "store_stats",
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
-            "node_health_ack", "node_stats",
+            "node_health_ack", "node_stats", "span",
         ]:
             self.server.register(
                 name, _validated(name, getattr(self, f"h_{name}"))
@@ -1900,6 +1900,16 @@ class Head:
         w = self.workers.get(worker_id) if worker_id else None
         if w is not None:
             w.last_ack = time.monotonic()
+        return {}
+
+    async def h_span(self, conn, body):
+        """Finished tracing span from any process -> timeline ring
+        (reference: task events flow to GcsTaskManager via
+        task_event_buffer.h; `ray timeline` reads them back)."""
+        self._event("span", **{k: body.get(k) for k in (
+            "trace_id", "span_id", "parent_id", "name", "start", "end",
+            "pid", "attrs",
+        )})
         return {}
 
     async def h_node_stats(self, conn, body):

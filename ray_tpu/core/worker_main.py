@@ -24,6 +24,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -190,6 +191,37 @@ class Worker:
             }
         return args, kwargs
 
+    def _setup_py_modules(self, keys) -> list:
+        """Extract content-addressed module archives and put their import
+        roots on sys.path (reference: runtime_env/py_modules.py — each
+        module ships as its own URI-cached package)."""
+        import io
+        import zipfile
+
+        roots = []
+        for key in keys:
+            _, name, digest = key.split(":", 2)
+            root = os.path.join("/tmp/ray_tpu_pymod", digest)
+            dest = os.path.join(root, name)
+            if not os.path.isdir(dest):
+                blob = self.client.kv_get(key)
+                if blob is None:
+                    raise RuntimeError(f"py_module archive {key} not found")
+                tmp = dest + f".tmp-{os.getpid()}"
+                with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                    zf.extractall(tmp)
+                os.makedirs(root, exist_ok=True)
+                try:
+                    os.rename(tmp, dest)
+                except OSError:  # raced another worker: theirs is identical
+                    import shutil
+
+                    shutil.rmtree(tmp, ignore_errors=True)
+            if root not in sys.path:
+                sys.path.insert(0, root)
+                roots.append(root)
+        return roots
+
     def _setup_working_dir(self, key: str):
         """Extract a content-addressed working_dir archive (cached per key)
         and enter it (reference: runtime_env/working_dir.py — URI-cached
@@ -276,6 +308,23 @@ class Worker:
         saved_env: Dict[str, Optional[str]] = {}
         saved_cwd: Optional[str] = None
         saved_wd_path: Optional[str] = None
+        pymod_roots: list = []
+        async_dispatched = False
+        # Tracing: install the submitter's span context so user spans and
+        # nested submissions inside this task become children (reference:
+        # tracing_helper.py wraps execution in the propagated span).
+        trace_token = None
+        trace_start = 0.0
+        injected = spec.get("trace_ctx")
+        if injected is not None:
+            from ray_tpu.util import tracing
+
+            trace_token = tracing.set_context({
+                "trace_id": injected["trace_id"],
+                "span_id": injected.get("task_span_id")
+                or injected["span_id"],
+            })
+            trace_start = time.time()
         try:
             if task_id in self.cancelled:
                 raise exceptions.TaskCancelledError(TaskID(task_id).hex())
@@ -304,6 +353,8 @@ class Worker:
                 saved_wd_path = self._setup_working_dir(
                     renv["working_dir_key"]
                 )
+            if renv.get("py_module_keys"):
+                pymod_roots = self._setup_py_modules(renv["py_module_keys"])
 
             if spec.get("is_actor_creation"):
                 cls = self._load(spec["func_key"])
@@ -333,6 +384,7 @@ class Worker:
                 if os.environ.get("RT_DEBUG_PUSH"):
                     print(f"ASYNC-DISPATCH {spec.get('name')} {spec['task_id'].hex()[:8]}",
                           file=sys.stderr, flush=True)
+                async_dispatched = True
                 self._execute_async(spec, fn, args, kwargs)
                 return
 
@@ -386,6 +438,19 @@ class Worker:
                         pass
                     if saved_wd_path in sys.path:
                         sys.path.remove(saved_wd_path)
+                for root in pymod_roots:
+                    if root in sys.path:
+                        sys.path.remove(root)
+            if injected is not None:
+                from ray_tpu.util import tracing
+
+                tracing.reset_context(trace_token)
+                if not async_dispatched:
+                    # Async actor methods emit their span from the coroutine
+                    # itself (the dispatch thread returns immediately).
+                    span = tracing.task_span(spec, trace_start, time.time())
+                    if span is not None:
+                        tracing._emit(span)
             self.running_threads.pop(task_id, None)
             ctx.current_task_id = None
             if _DEBUG_PUSH:
@@ -440,12 +505,37 @@ class Worker:
                 name="actor-async-loop",
             ).start()
 
+        injected = spec.get("trace_ctx")
+
         async def run():
+            # Tracing: the span must cover the coroutine's real lifetime and
+            # the context must live on THIS (event-loop) thread so nested
+            # spans/submissions inside the method parent correctly — the
+            # dispatching thread's context is useless here.
+            token = None
+            start = 0.0
+            if injected is not None:
+                from ray_tpu.util import tracing
+
+                token = tracing.set_context({
+                    "trace_id": injected["trace_id"],
+                    "span_id": injected.get("task_span_id")
+                    or injected["span_id"],
+                })
+                start = time.time()
             try:
                 result = await fn(*args, **kwargs)
                 self._finish_ok(spec, result)
             except BaseException as e:  # noqa: BLE001
                 self._finish_err(spec, e)
+            finally:
+                if injected is not None:
+                    from ray_tpu.util import tracing
+
+                    tracing.reset_context(token)
+                    span = tracing.task_span(spec, start, time.time())
+                    if span is not None:
+                        tracing._emit(span)
 
         asyncio.run_coroutine_threadsafe(run(), self.async_loop)
 

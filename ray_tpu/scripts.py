@@ -135,7 +135,14 @@ def cmd_timeline(args) -> int:
     cl = _client(args.address)
     try:
         items = cl.call("list_state", {"kind": "timeline"})["items"]
-        print(json.dumps(items, indent=1, default=str))
+        if getattr(args, "chrome", False):
+            # chrome://tracing / Perfetto format from span events
+            # (reference: `ray timeline` emits the same shape).
+            from .util.tracing import chrome_trace
+
+            print(json.dumps(chrome_trace(items)))
+        else:
+            print(json.dumps(items, indent=1, default=str))
     finally:
         cl.close()
     return 0
@@ -185,6 +192,8 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("timeline", help="task event timeline (json)")
+    p.add_argument("--chrome", action="store_true",
+                   help="emit chrome://tracing span JSON")
     p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")

@@ -1,0 +1,141 @@
+"""Distributed tracing: span context propagation across task boundaries.
+
+Role-equivalent to the reference's OpenTelemetry integration
+(reference: python/ray/util/tracing/tracing_helper.py — _DictPropagator:165
+injects the active span context into task specs; spans wrap submission and
+execution) — re-designed without an OTel dependency: trace context is a
+(trace_id, span_id) pair carried in the task spec, spans are recorded into
+the head's timeline ring (task_event_buffer.h's role) and exported as a
+Chrome trace by ``python -m ray_tpu timeline --chrome``.
+
+Usage::
+
+    with tracing.trace("preprocess"):       # user span inside a task
+        ...
+    # Submission inside a traced region propagates (trace_id, span_id) to
+    # the child task automatically; the child's execution span is recorded
+    # with parent_id linking the tree.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Any, Dict, Optional
+
+_current: contextvars.ContextVar[Optional[Dict[str, str]]] = (
+    contextvars.ContextVar("rt_trace_ctx", default=None)
+)
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The active {trace_id, span_id}, or None outside any trace."""
+    return _current.get()
+
+
+def context_for_submit() -> Optional[Dict[str, str]]:
+    """Trace context to inject into an outgoing task spec (reference:
+    _DictPropagator.inject_current_context)."""
+    return _current.get()
+
+
+def set_context(ctx: Optional[Dict[str, str]]):
+    """Install the context received with an executing task; returns a token
+    for reset."""
+    return _current.set(ctx)
+
+
+def reset_context(token) -> None:
+    _current.reset(token)
+
+
+def _emit(span: Dict[str, Any]) -> None:
+    """Record a finished span into the cluster timeline (best-effort)."""
+    from ..core.context import ctx as rt_ctx
+
+    if rt_ctx.client is None:
+        return
+    try:
+        rt_ctx.client.call_bg("span", span)
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def trace(name: str, **attrs):
+    """A named span.  Nested spans and tasks submitted inside it become
+    children; the finished span lands in the cluster timeline."""
+    parent = _current.get()
+    span_ctx = {
+        "trace_id": parent["trace_id"] if parent else _new_id(),
+        "span_id": _new_id(),
+    }
+    token = _current.set(span_ctx)
+    start = time.time()
+    try:
+        yield span_ctx
+    finally:
+        _current.reset(token)
+        _emit({
+            "trace_id": span_ctx["trace_id"],
+            "span_id": span_ctx["span_id"],
+            "parent_id": parent["span_id"] if parent else None,
+            "name": name,
+            "start": start,
+            "end": time.time(),
+            "pid": os.getpid(),
+            **({"attrs": attrs} if attrs else {}),
+        })
+
+
+def task_span(spec: Dict[str, Any], start: float, end: float) -> Optional[dict]:
+    """Build the execution span for a finished task from its spec's injected
+    context (None when the submission wasn't traced and tracing isn't
+    forced)."""
+    injected = spec.get("trace_ctx")
+    if injected is None:
+        return None
+    return {
+        "trace_id": injected["trace_id"],
+        "span_id": injected.get("task_span_id") or _new_id(),
+        "parent_id": injected.get("span_id"),
+        "name": f"task:{spec.get('name', 'anonymous')}",
+        "start": start,
+        "end": end,
+        "pid": os.getpid(),
+    }
+
+
+def chrome_trace(events) -> list:
+    """Convert timeline span events into Chrome trace-event JSON (the
+    `ray timeline` output format — reference: chrome://tracing 'X' complete
+    events keyed by pid/tid)."""
+    out = []
+    for ev in events:
+        if ev.get("kind") != "span":
+            continue
+        if not isinstance(ev.get("start"), (int, float)) \
+                or not isinstance(ev.get("end"), (int, float)):
+            continue  # malformed emitter: skip, don't kill the export
+        out.append({
+            "name": ev.get("name", "span"),
+            "cat": ev.get("trace_id", ""),
+            "ph": "X",
+            "ts": ev["start"] * 1e6,
+            "dur": max(ev["end"] - ev["start"], 0) * 1e6,
+            "pid": ev.get("pid", 0),
+            "tid": ev.get("pid", 0),
+            "args": {
+                "trace_id": ev.get("trace_id"),
+                "span_id": ev.get("span_id"),
+                "parent_id": ev.get("parent_id"),
+                **(ev.get("attrs") or {}),
+            },
+        })
+    return out

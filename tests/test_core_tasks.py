@@ -216,3 +216,30 @@ def test_runtime_env_working_dir(rt_shared, tmp_path):
         return helper_mod_wd.VALUE, open("data.txt").read()
 
     assert ray_tpu.get(uses_wd.remote()) == (123, "payload")
+
+
+def test_runtime_env_py_modules(rt, tmp_path):
+    """py_modules ships import roots to workers (reference:
+    _private/runtime_env/py_modules.py URI-cached module packages)."""
+    mod = tmp_path / "shipped_mod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("MAGIC = 'from-shipped-module'\n")
+    (mod / "helper.py").write_text("def double(x):\n    return x * 2\n")
+
+    @rt.remote(runtime_env={"py_modules": [str(mod)]})
+    def use_module():
+        import shipped_mod
+        from shipped_mod.helper import double
+
+        return shipped_mod.MAGIC, double(21)
+
+    assert rt.get(use_module.remote()) == ("from-shipped-module", 42)
+
+    # Pooled workers drop the import root afterwards.
+    @rt.remote
+    def plain():
+        import sys
+
+        return any("ray_tpu_pymod" in p for p in sys.path)
+
+    assert rt.get(plain.remote()) is False

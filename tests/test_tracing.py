@@ -1,0 +1,137 @@
+"""Distributed tracing: span context propagation across task boundaries.
+
+Reference analog: python/ray/util/tracing/tracing_helper.py (OTel context
+injected into task specs; spans wrap submission and execution) and
+`ray timeline`'s Chrome trace export.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_trace_context_nesting_unit():
+    assert tracing.current_context() is None
+    with tracing.trace("outer") as outer:
+        assert tracing.current_context()["span_id"] == outer["span_id"]
+        with tracing.trace("inner") as inner:
+            assert inner["trace_id"] == outer["trace_id"]
+            assert inner["span_id"] != outer["span_id"]
+        assert tracing.current_context()["span_id"] == outer["span_id"]
+    assert tracing.current_context() is None
+
+
+def test_chrome_trace_format():
+    events = [
+        {"kind": "span", "trace_id": "t", "span_id": "s", "parent_id": None,
+         "name": "work", "start": 10.0, "end": 10.5, "pid": 7},
+        {"kind": "task_dispatched"},  # non-span events are skipped
+    ]
+    out = tracing.chrome_trace(events)
+    assert len(out) == 1
+    ev = out[0]
+    assert ev["ph"] == "X" and ev["name"] == "work"
+    assert ev["dur"] == pytest.approx(0.5e6)
+    assert ev["args"]["span_id"] == "s"
+
+
+def test_task_spans_link_to_driver_span(rt_shared):
+    """A task submitted inside a driver span records an execution span
+    whose parent is the driver span; nested user spans inside the task
+    join the same trace."""
+    from ray_tpu.core.context import ctx
+
+    @ray_tpu.remote
+    def work(x):
+        from ray_tpu.util import tracing as t
+
+        with t.trace("inside"):
+            time.sleep(0.01)
+        return x + 1
+
+    with tracing.trace("driver_section") as root:
+        assert ray_tpu.get(work.remote(1)) == 2
+
+    deadline = time.monotonic() + 10
+    spans = []
+    while time.monotonic() < deadline:
+        events = ctx.client.call("list_state", {"kind": "timeline"})["items"]
+        spans = [e for e in events if e.get("kind") == "span"
+                 and e.get("trace_id") == root["trace_id"]]
+        if len(spans) >= 3:  # driver_section + task:work + inside
+            break
+        time.sleep(0.2)
+    names = {s["name"] for s in spans}
+    assert "driver_section" in names and "task:work" in names \
+        and "inside" in names, names
+
+    by_name = {s["name"]: s for s in spans}
+    task_span = by_name["task:work"]
+    assert task_span["parent_id"] == root["span_id"]
+    # The in-task user span parents to the task's execution span.
+    assert by_name["inside"]["parent_id"] == task_span["span_id"]
+
+
+def test_untraced_tasks_emit_no_spans(rt_shared):
+    from ray_tpu.core.context import ctx
+
+    @ray_tpu.remote
+    def plain():
+        return 1
+
+    assert ray_tpu.get(plain.remote()) == 1
+    time.sleep(0.3)
+    events = ctx.client.call("list_state", {"kind": "timeline"})["items"]
+    assert not any(e.get("kind") == "span"
+                   and e.get("name") == "task:plain" for e in events)
+
+
+def test_async_actor_span_covers_await(rt_shared):
+    """Async actor method spans are emitted from the coroutine: duration
+    covers the await and nested spans parent to the execution span
+    (regression: spans were emitted at dispatch, ~0ms, with no context on
+    the loop thread)."""
+    from ray_tpu.core.context import ctx
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def slow(self):
+            from ray_tpu.util import tracing as t
+
+            with t.trace("awaited_work"):
+                import asyncio
+
+                await asyncio.sleep(0.15)
+            return "done"
+
+    a = AsyncActor.remote()
+    with tracing.trace("async_root") as root:
+        assert ray_tpu.get(a.slow.remote()) == "done"
+
+    deadline = time.monotonic() + 10
+    by_name = {}
+    while time.monotonic() < deadline:
+        events = ctx.client.call("list_state", {"kind": "timeline"})["items"]
+        spans = [e for e in events if e.get("kind") == "span"
+                 and e.get("trace_id") == root["trace_id"]]
+        by_name = {s["name"]: s for s in spans}
+        if {"task:AsyncActor.slow", "awaited_work"} <= set(by_name):
+            break
+        time.sleep(0.2)
+    task_span = by_name.get("task:AsyncActor.slow")
+    assert task_span is not None, sorted(by_name)
+    assert task_span["end"] - task_span["start"] >= 0.14
+    assert by_name["awaited_work"]["parent_id"] == task_span["span_id"]
+
+
+def test_chrome_trace_skips_malformed_spans():
+    out = tracing.chrome_trace([
+        {"kind": "span", "trace_id": "t", "span_id": "a", "name": "ok",
+         "start": 1.0, "end": 2.0},
+        {"kind": "span", "trace_id": "t", "span_id": "b", "name": "bad",
+         "start": None, "end": None},
+    ])
+    assert [e["name"] for e in out] == ["ok"]
