@@ -34,6 +34,9 @@ _build_lock = threading.Lock()
 
 def _so_path() -> str:
     tag = f"{sys.implementation.cache_tag}-{os.uname().machine}"
+    san = os.environ.get("RT_NATIVE_SANITIZE")
+    if san:
+        tag += f"-{san}"  # never let a sanitized build shadow the normal one
     return os.path.join(_HERE, f"_fastpath.{tag}.so")
 
 
@@ -49,6 +52,20 @@ def _build(so: str) -> bool:
     inc = sysconfig.get_path("include")
     tmp = f"{so}.build-{os.getpid()}.so"
     cmd = [cc, "-O3", "-shared", "-fPIC", "-pthread", f"-I{inc}", _SRC, "-o", tmp]
+    # Sanitized builds for the native data plane (the role of the
+    # reference's bazel tsan/asan configs gating its C++ runtime —
+    # .bazelrc build:tsan/build:asan): RT_NATIVE_SANITIZE=thread|address
+    # rebuilds the extension instrumented; run python with
+    # LD_PRELOAD=$(cc -print-file-name=lib<san>.so) so the sanitizer
+    # runtime is present at dlopen (otherwise import falls back to pure
+    # Python).  E.g.:
+    #   rm ray_tpu/_native/_fastpath.*.so
+    #   LD_PRELOAD=$(cc -print-file-name=libtsan.so) \
+    #     RT_NATIVE_SANITIZE=thread python -m pytest tests/test_core_units.py
+    san = os.environ.get("RT_NATIVE_SANITIZE")
+    if san in ("thread", "address", "undefined"):
+        cmd.insert(1, f"-fsanitize={san}")
+        cmd.insert(1, "-g")
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
         if proc.returncode != 0:
