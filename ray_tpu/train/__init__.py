@@ -5,7 +5,13 @@ jax/pjit: instead of wrapping models in DDP/FSDP, a ScalingConfig carries a
 MeshConfig and models shard via ShardingRules (ray_tpu.models.make_train_step).
 """
 
-from .checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
+from .checkpoint import (
+    AsyncCheckpointWriter,
+    Checkpoint,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
 from .config import (
     CheckpointConfig,
     FailureConfig,
@@ -24,6 +30,7 @@ from .trainer import DataParallelTrainer, JaxTrainer, TrainingFailedError
 
 __all__ = [
     "Checkpoint", "CheckpointManager", "save_pytree", "load_pytree",
+    "AsyncCheckpointWriter",
     "RunConfig", "ScalingConfig", "FailureConfig", "CheckpointConfig",
     "Result", "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "get_mesh",

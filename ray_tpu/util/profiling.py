@@ -1,0 +1,65 @@
+"""Device profiling: XLA/TPU traces through jax.profiler.
+
+Role-equivalent to the reference's profiling hooks (reference:
+python/ray/_private/profiling.py + the nsight runtime-env plugin at
+_private/runtime_env/nsight.py for CUDA) — on TPU the profiler of record
+is XLA's own (jax.profiler → TensorBoard/XProf: device timelines, HLO
+cost analysis, MXU utilization), so this module wraps it with the
+framework's conventions instead of shipping a vendor plugin:
+
+    from ray_tpu.util import profiling
+
+    with profiling.device_trace("/tmp/tb"):       # whole-section trace
+        for step in range(10):
+            with profiling.step_annotation(step): # XLA StepMarker
+                state, _ = train_step(state, batch)
+
+View with ``tensorboard --logdir /tmp/tb`` (the trace lands under
+``plugins/profile``).  Works on CPU too (host tracing only), so tests and
+dry runs exercise the same code path as TPU runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str, *,
+                 host_tracer_level: Optional[int] = None) -> Iterator[None]:
+    """Capture a jax.profiler trace of the enclosed block into ``log_dir``."""
+    import jax
+
+    kwargs = {}
+    if host_tracer_level is not None:
+        try:
+            kwargs["profiler_options"] = jax.profiler.ProfileOptions(
+                host_tracer_level=host_tracer_level
+            )
+        except (AttributeError, TypeError):
+            pass  # older jax: default options
+    jax.profiler.start_trace(log_dir, **kwargs)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def step_annotation(step: int, name: str = "train") -> Iterator[None]:
+    """Mark one train step so XProf groups device ops per step
+    (jax.profiler.StepTraceAnnotation)."""
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield
+
+
+@contextlib.contextmanager
+def annotation(name: str) -> Iterator[None]:
+    """Named region in the host timeline (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

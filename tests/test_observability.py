@@ -162,3 +162,24 @@ def test_job_failure_status(rt):
     client = JobSubmissionClient()
     job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
     assert client.wait_until_finished(job_id, timeout=120) == "FAILED"
+
+
+def test_device_trace_produces_profile(tmp_path):
+    """jax.profiler wrapper: a traced block writes a TensorBoard profile
+    (the TPU-side profiling story — reference ships nsight plugins for
+    CUDA; XLA's profiler is the TPU equivalent)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.util import profiling
+
+    logdir = str(tmp_path / "tb")
+    with profiling.device_trace(logdir):
+        with profiling.step_annotation(0):
+            x = jnp.arange(1024.0)
+            with profiling.annotation("square"):
+                (x * x).block_until_ready()
+
+    import glob as g
+
+    traces = g.glob(f"{logdir}/**/plugins/profile/**/*", recursive=True)
+    assert traces, f"no profile output under {logdir}"

@@ -93,3 +93,33 @@ def test_mesh_none_without_config(rt, tmp_path):
         run_config=RunConfig(storage_path=str(tmp_path)),
     )
     assert trainer.fit().error is None
+
+
+def test_async_checkpoint_writer(tmp_path):
+    """Async saves overlap the train loop; wait() makes them durable and
+    surfaces write errors (SURVEY §7: async checkpointing)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.train import AsyncCheckpointWriter, load_pytree
+
+    w = AsyncCheckpointWriter()
+    dest = str(tmp_path / "step10")
+    tree = {"p": jnp.arange(1024.0), "opt": {"m": jnp.ones((4, 4))}}
+    w.save(tree, dest)
+    w.wait()
+    back = load_pytree(dest)
+    assert float(back["p"][-1]) == 1023.0
+    assert back["opt"]["m"].shape == (4, 4)
+
+    # Sequential saves replace atomically; the newest wins.
+    for step in (11, 12):
+        w.save({"p": jnp.full((8,), float(step))}, dest)
+    w.wait()
+    assert float(load_pytree(dest)["p"][0]) == 12.0
+
+    # A failing write surfaces on wait(), not silently.
+    import pytest
+
+    w.save(tree, "/proc/definitely/not/writable/ckpt")
+    with pytest.raises(OSError):
+        w.wait()
