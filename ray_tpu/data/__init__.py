@@ -28,6 +28,7 @@ from .dataset import (
     range,  # noqa: A001  (shadows builtins.range on purpose, like the reference)
     range_tensor,
     read_csv,
+    read_images,
     read_json,
     read_parquet,
 )
@@ -36,5 +37,6 @@ from .iterator import DataIterator
 __all__ = [
     "Block", "DataContext", "Dataset", "DataIterator",
     "from_arrow", "from_items", "from_numpy", "from_pandas",
-    "range", "range_tensor", "read_csv", "read_json", "read_parquet",
+    "range", "range_tensor", "read_csv", "read_images", "read_json",
+    "read_parquet",
 ]

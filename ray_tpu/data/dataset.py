@@ -983,3 +983,29 @@ def read_json(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
     return _read_source(
         _expand_paths(paths, (".json", ".jsonl")), reader, override_num_blocks
     )
+
+
+def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
+                include_paths: bool = False,
+                override_num_blocks: Optional[int] = None) -> Dataset:
+    """Image files -> blocks with an "image" uint8 tensor column
+    (reference: data/read_api.py read_images / datasource ImageDatasource).
+    ``size=(h, w)`` resizes so the column has a uniform tensor shape —
+    required when source images vary (the batch format is dense numpy)."""
+    def reader(f: str) -> Block:
+        from PIL import Image
+
+        with Image.open(f) as im:
+            im = im.convert(mode)
+            if size is not None:
+                im = im.resize((size[1], size[0]))  # PIL takes (w, h)
+            arr = np.asarray(im, dtype=np.uint8)
+        cols = {"image": arr[None]}
+        if include_paths:
+            cols["path"] = np.array([f], dtype=object)
+        return Block.from_batch(cols)
+
+    return _read_source(
+        _expand_paths(paths, (".png", ".jpg", ".jpeg", ".bmp", ".gif")),
+        reader, override_num_blocks,
+    )

@@ -148,6 +148,30 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Declarative Serve operations (reference: `serve deploy/status/
+    shutdown` CLI over the schema config)."""
+    os.environ.setdefault("RT_ADDRESS", _resolve_address(args.address))
+    from ray_tpu import serve as rt_serve
+
+    if args.action == "deploy":
+        if not args.config:
+            raise SystemExit("serve deploy requires a config file path")
+        handles = rt_serve.deploy_config(args.config)
+        print(f"deployed {len(handles)} application(s)")
+        st = rt_serve.status()
+        for name, info in sorted(st.items()):
+            print(f"  {name}: {info['running_replicas']}/"
+                  f"{info['target_replicas']} replicas")
+    elif args.action == "status":
+        for name, info in sorted(rt_serve.status().items()):
+            print(f"{name}: {info}")
+    elif args.action == "shutdown":
+        rt_serve.shutdown()
+        print("serve shut down")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     """Serve the web dashboard against a running cluster (reference:
     dashboard/head.py runs as its own process attached to the GCS)."""
@@ -195,6 +219,11 @@ def main(argv=None) -> int:
     p.add_argument("--chrome", action="store_true",
                    help="emit chrome://tracing span JSON")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("serve", help="declarative serve operations")
+    p.add_argument("action", choices=["deploy", "status", "shutdown"])
+    p.add_argument("config", nargs="?", help="YAML config (for deploy)")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--host", default="127.0.0.1")

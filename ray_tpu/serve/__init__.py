@@ -19,6 +19,7 @@ from .api import (
     stop_http,
 )
 from .batching import batch
+from .config import deploy as deploy_config
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
 
@@ -26,5 +27,5 @@ __all__ = [
     "deployment", "Deployment", "Application", "run", "delete", "status",
     "shutdown", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "batch", "start_http", "stop_http",
-    "multiplexed", "get_multiplexed_model_id",
+    "multiplexed", "get_multiplexed_model_id", "deploy_config",
 ]

@@ -80,20 +80,25 @@ def load_pytree(directory: str, name: str = "state") -> Any:
 
 
 class AsyncCheckpointWriter:
-    """Non-blocking checkpoint saves: the device→host DMA starts
-    immediately (`copy_to_host_async`), serialization and disk IO run on a
-    background thread, and the train loop keeps stepping.
+    """Overlapped checkpoint saves: ``save()`` snapshots the pytree to host
+    memory synchronously (cheap: the D2H DMA is kicked with
+    ``copy_to_host_async`` first, so the transfers run in parallel and the
+    blocking part is just their completion), then serialization and disk IO
+    run on a background thread while the train loop keeps stepping.
 
-    This is the async-checkpointing requirement from the scaling plan
-    (SURVEY §7: MFU at scale needs checkpoint writes overlapped with
-    compute; the reference reaches the same overlap through Tune's
-    threaded checkpoint upload, train/_internal/storage.py).  JAX arrays
-    are immutable, so holding the snapshot's references keeps the old
-    params alive (HBM cost of one extra copy) while the next steps write
-    new buffers — no torment about torn state.
+    The synchronous host snapshot is REQUIRED for correctness, not an
+    implementation detail: the default train step donates the state
+    (models/train_state.py donate_state=True), so the device buffers are
+    deleted by the very next step — a background thread reading live
+    jax.Arrays would crash.  What overlaps is the expensive part (pickle +
+    disk/remote IO — the reference gets the same overlap from Tune's
+    threaded checkpoint upload, train/_internal/storage.py; SURVEY §7
+    lists async checkpointing as an MFU requirement).
 
-    One save is in flight at a time: a new `save` waits for the previous
-    write to land (bounded memory, ordered checkpoints).
+    One save is in flight at a time: a new ``save`` waits for the previous
+    write to land (bounded memory, ordered checkpoints).  The writer
+    thread is non-daemon, so a process that exits right after ``save``
+    still finishes the write.
     """
 
     def __init__(self):
@@ -102,41 +107,78 @@ class AsyncCheckpointWriter:
         self._error: Optional[BaseException] = None
 
     def save(self, tree: Any, directory: str, name: str = "state") -> None:
-        """Start an async save of ``tree`` into ``directory``.  Blocks only
-        if the previous save hasn't finished."""
+        """Snapshot ``tree`` to host and start the async write.  Blocks
+        only for the D2H copy (and any unfinished previous save)."""
         import jax
+        import numpy as np
 
         self.wait()  # one in flight; surfaces prior errors
-        # Kick the D2H transfers now so they overlap the next train step.
+        # Kick every transfer first so they overlap each other...
         jax.tree.map(
             lambda x: x.copy_to_host_async()
             if hasattr(x, "copy_to_host_async") else None,
             tree,
         )
+        # ...then complete them into host arrays.  After this line the
+        # snapshot is independent of device state (donation-safe).
+        host_tree = jax.tree.map(lambda x: np.asarray(x), tree)
 
         def write():
             tmp = directory + f".tmp-{os.getpid()}"
-            old = directory + ".old"
             try:
-                save_pytree(tree, tmp, name)  # np.asarray completes the DMA
-                # Publish without a window where NO checkpoint exists: the
-                # previous good dir moves aside first, the new one renames
-                # in, then the old is dropped.  A crash mid-sequence leaves
-                # either dest or dest.old loadable (never neither).
-                shutil.rmtree(old, ignore_errors=True)
+                os.makedirs(tmp, exist_ok=True)
+                with open(os.path.join(tmp, f"{name}.pkl"), "wb") as f:
+                    pickle.dump(host_tree, f, protocol=5)
+                # Publish without a window where NO checkpoint exists:
+                # move the previous good dir aside (unique name), rename
+                # the new one in, then drop the old.  A crash mid-sequence
+                # leaves dest or a dest.old-* loadable — `recover` restores
+                # the newest one.
+                old = None
                 if os.path.isdir(directory):
+                    old = f"{directory}.old-{uuid.uuid4().hex[:8]}"
                     os.rename(directory, old)
                 os.rename(tmp, directory)
-                shutil.rmtree(old, ignore_errors=True)
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
+                # Sweep stale .old-* left by crashes of earlier publishes.
+                parent = os.path.dirname(directory) or "."
+                base = os.path.basename(directory)
+                for entry in os.listdir(parent):
+                    if entry.startswith(base + ".old-"):
+                        shutil.rmtree(os.path.join(parent, entry),
+                                      ignore_errors=True)
             except BaseException as e:  # noqa: BLE001 — surfaced on wait()
                 self._error = e
                 shutil.rmtree(tmp, ignore_errors=True)  # never reuse stale tmp
 
         with self._lock:
             self._pending = threading.Thread(
-                target=write, name="async-ckpt", daemon=True
+                target=write, name="async-ckpt"
             )
             self._pending.start()
+
+    @staticmethod
+    def recover(directory: str) -> Optional[str]:
+        """Crash recovery: if ``directory`` is missing but a publish left a
+        ``.old-*`` sibling, restore the newest one and return the usable
+        path (or None when nothing is recoverable)."""
+        if os.path.isdir(directory):
+            return directory
+        parent = os.path.dirname(directory) or "."
+        base = os.path.basename(directory)
+        try:
+            candidates = sorted(
+                (e for e in os.listdir(parent)
+                 if e.startswith(base + ".old-")),
+                key=lambda e: os.path.getmtime(os.path.join(parent, e)),
+            )
+        except OSError:
+            return None
+        if not candidates:
+            return None
+        os.rename(os.path.join(parent, candidates[-1]), directory)
+        return directory
 
     def wait(self) -> None:
         """Block until the in-flight save (if any) is durable; re-raises a

@@ -1,0 +1,168 @@
+"""Chaos-testing utilities: kill workers/nodes on a cadence.
+
+Role-equivalent to the reference's fault-injection test tooling
+(reference: python/ray/_private/test_utils.py:1433 ResourceKillerActor,
+:1500 NodeKillerBase, :1597 WorkerKillerActor; the release chaos harness
+at release/nightly_tests/setup_chaos.py) — used by resilience tests and
+available to users who want to soak their own pipelines against failures.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Optional
+
+import ray_tpu
+
+
+class WorkerKiller:
+    """SIGKILLs a random busy worker every ``interval_s`` until stopped.
+
+    Runs in the driver (it needs os.kill on local pids; remote workers die
+    through their node daemon's kill route when the head requests it — for
+    cross-node chaos use NodeKiller).  Retriable tasks should still
+    complete; the kill count is the assertion hook.
+    """
+
+    def __init__(self, interval_s: float = 1.0, seed: int = 0,
+                 states: tuple = ("leased",)):
+        self.interval_s = interval_s
+        self.states = states
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    def _loop(self):
+        from ray_tpu.core.context import ctx
+
+        local_node = (
+            ctx.client.node_id.hex()
+            if ctx.client and ctx.client.node_id else None
+        )
+        while not self._stop.wait(self.interval_s):
+            try:
+                workers = ctx.client.call(
+                    "list_state", {"kind": "workers"}
+                )["items"]
+            except Exception:
+                continue
+            busy = [
+                w for w in workers
+                if w.get("state") in self.states and w.get("pid")
+                # os.kill is only valid for pids this host owns: never
+                # signal a pid reported by another node's daemon.
+                and (local_node is None or w.get("node_id") == local_node)
+            ]
+            if not busy:
+                continue
+            victim = self._rng.choice(busy)
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+                self.kills += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def start(self) -> "WorkerKiller":
+        self._thread = threading.Thread(
+            target=self._loop, name="worker-killer", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return self.kills
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class NodeKiller:
+    """Removes random non-head nodes from a ``cluster_utils.Cluster`` on a
+    cadence (reference: NodeKillerBase kills raylets) — exercises task
+    re-scheduling, object reconstruction, and PG bundle re-placement."""
+
+    def __init__(self, cluster, interval_s: float = 2.0, seed: int = 0,
+                 max_kills: Optional[int] = None):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None and self.kills >= self.max_kills:
+                return
+            nodes = list(getattr(self.cluster, "nodes", []) or [])
+            if not nodes:
+                continue
+            victim = self._rng.choice(nodes)
+            try:
+                self.cluster.remove_node(victim)
+                self.kills += 1
+            except Exception:
+                pass
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(
+            target=self._loop, name="node-killer", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return self.kills
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def run_under_chaos(fn, *, interval_s: float = 0.5, timeout_s: float = 60.0,
+                    seed: int = 0):
+    """Run ``fn()`` while a WorkerKiller fires; returns (result, kills).
+    The canonical soak shape (reference: chaos tests wrap a workload with
+    setup_chaos).  ``timeout_s`` bounds a HUNG workload — the exact
+    failure a chaos soak exists to catch — by running it on a worker
+    thread; on timeout the thread is abandoned (daemonic) and
+    TimeoutError raised."""
+    killer = WorkerKiller(interval_s=interval_s, seed=seed).start()
+    box: dict = {}
+
+    def target():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=target, name="chaos-workload", daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    try:
+        if t.is_alive():
+            raise TimeoutError(
+                f"workload still running after {timeout_s}s under chaos"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["result"], killer.kills
+    finally:
+        killer.stop()

@@ -1,0 +1,125 @@
+"""Chaos tooling + declarative serve config + image reads.
+
+Reference analogs: _private/test_utils.py WorkerKillerActor/NodeKillerBase,
+serve/schema.py + `serve deploy`, data read_images.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_retriable_work_survives_worker_chaos(rt):
+    """Tasks with retries complete while a WorkerKiller shoots busy
+    workers (reference: chaos_test pattern — kill cadence under load)."""
+    from ray_tpu.util.chaos import WorkerKiller
+
+    @ray_tpu.remote(max_retries=10)
+    def slow(i):
+        time.sleep(0.25)
+        return i * 2
+
+    with WorkerKiller(interval_s=0.3, seed=1) as killer:
+        results = ray_tpu.get([slow.remote(i) for i in range(12)],
+                              timeout=120)
+    assert results == [i * 2 for i in range(12)]
+    assert killer.kills >= 1, "chaos never fired; the test proved nothing"
+
+
+def test_serve_deploy_config_yaml(rt, tmp_path):
+    """Declarative deploy: YAML -> import_path -> bound app with
+    per-deployment overrides (reference: serve/schema.py ServeDeploySchema,
+    `serve deploy`)."""
+    from ray_tpu import serve
+
+    mod = tmp_path / "served_app.py"
+    mod.write_text(
+        "from ray_tpu import serve\n"
+        "\n"
+        "@serve.deployment\n"
+        "class Greeter:\n"
+        "    def __init__(self, greeting='hello'):\n"
+        "        self.greeting = greeting\n"
+        "    def __call__(self, who):\n"
+        "        return f'{self.greeting} {who}'\n"
+        "\n"
+        "app = Greeter.bind(greeting='hi')\n"
+    )
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: greeter\n"
+        "    import_path: served_app:app\n"
+        "    deployments:\n"
+        "      - name: Greeter\n"
+        "        num_replicas: 2\n"
+    )
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        handles = serve.deploy_config(str(cfg))
+        assert handles[0].remote("world").result() == "hi world"
+        assert serve.status()["greeter"]["target_replicas"] == 2
+    finally:
+        sys.path.remove(str(tmp_path))
+        serve.shutdown()
+
+
+def test_read_images(rt, tmp_path):
+    from PIL import Image
+
+    import ray_tpu.data as rd
+
+    for i in range(4):
+        Image.new("RGB", (8 + i, 6 + i), color=(i * 10, 0, 0)).save(
+            tmp_path / f"img_{i}.png"
+        )
+    ds = rd.read_images(str(tmp_path), size=(16, 12), include_paths=True)
+    batch = next(ds.iter_batches(batch_size=4))
+    assert batch["image"].shape == (4, 16, 12, 3)
+    assert batch["image"].dtype == np.uint8
+    assert all("img_" in p for p in batch["path"])
+
+
+def test_serve_config_unknown_override_rejected(rt, tmp_path):
+    from ray_tpu import serve
+    from ray_tpu.serve.config import _apply_overrides
+
+    @serve.deployment
+    class D:
+        def __call__(self):
+            return 1
+
+    with pytest.raises(ValueError, match="match nothing"):
+        _apply_overrides(D.bind(), [{"name": "Typo", "num_replicas": 3}])
+
+
+def test_async_checkpoint_recover(tmp_path):
+    """Crash recovery: a publish interrupted between rename(dest->old) and
+    rename(tmp->dest) leaves only dest.old-*; recover() restores it."""
+    import os
+
+    from ray_tpu.train import AsyncCheckpointWriter
+
+    dest = str(tmp_path / "ck")
+    old = dest + ".old-deadbeef"
+    os.makedirs(old)
+    with open(os.path.join(old, "state.pkl"), "wb") as f:
+        f.write(b"x")
+    assert AsyncCheckpointWriter.recover(dest) == dest
+    assert os.path.isdir(dest) and not os.path.isdir(old)
+    # Idempotent when dest already exists.
+    assert AsyncCheckpointWriter.recover(dest) == dest
