@@ -20,6 +20,7 @@ from .api import (
 )
 from .batching import batch
 from .config import deploy as deploy_config
+from .grpc_ingress import start_grpc, stop_grpc
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
 
@@ -28,4 +29,5 @@ __all__ = [
     "shutdown", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "batch", "start_http", "stop_http",
     "multiplexed", "get_multiplexed_model_id", "deploy_config",
+    "start_grpc", "stop_grpc",
 ]

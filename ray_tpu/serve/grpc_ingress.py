@@ -1,0 +1,123 @@
+"""gRPC ingress for Serve.
+
+Role-equivalent to the reference's gRPCProxy (reference:
+serve/_private/proxy.py:545 gRPCProxy routed beside the HTTP proxy) —
+re-designed without protobuf codegen: one generic unary method,
+
+    /ray_tpu.serve.ServeAPI/Call
+
+whose request/response bodies are JSON bytes::
+
+    request:  {"deployment": "Name", "method": "__call__",
+               "args": [...], "kwargs": {...},
+               "multiplexed_model_id": ""}
+    response: {"result": <json>}
+
+Application errors surface as gRPC INTERNAL status with the exception
+text; unknown deployments as NOT_FOUND.  Any gRPC client in any language
+can call it with a bytes-in/bytes-out stub — no generated code needed on
+either side.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Dict, Optional
+
+CALL_METHOD = "/ray_tpu.serve.ServeAPI/Call"
+
+
+class _GrpcIngress:
+    def __init__(self, host: str, port: int):
+        import grpc
+
+        from .handle import DeploymentHandle
+
+        handles: Dict[tuple, DeploymentHandle] = {}
+
+        def call(request: bytes, context):
+            try:
+                req = json.loads(request)
+                if not isinstance(req, dict):
+                    raise TypeError(
+                        f"body must be a JSON object, got "
+                        f"{type(req).__name__}"
+                    )
+                name = req["deployment"]
+            except (ValueError, KeyError, TypeError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"bad request body: {e}")
+            key = (name, req.get("method", "__call__"),
+                   req.get("multiplexed_model_id", ""))
+            h = handles.get(key)
+            if h is None:
+                # First request for this route: verify the deployment
+                # exists so an unknown name fails fast instead of waiting
+                # out the router's replica deadline.
+                from .api import status as serve_status
+
+                try:
+                    known = serve_status()
+                except Exception:
+                    known = None
+                if known is not None and name not in known:
+                    context.abort(grpc.StatusCode.NOT_FOUND,
+                                  f"no deployment named {name!r}")
+                h = handles[key] = DeploymentHandle(
+                    name, key[1], multiplexed_model_id=key[2]
+                )
+            try:
+                result = h.remote(
+                    *(req.get("args") or []), **(req.get("kwargs") or {})
+                ).result()
+                # Serialize inside the mapping too: a non-JSON result
+                # (arrays, bytes) must answer INTERNAL with the reason,
+                # not a blank UNKNOWN.
+                return json.dumps({"result": result}).encode()
+            except RuntimeError as e:
+                if "no running replicas" in str(e):
+                    context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+            except Exception as e:  # noqa: BLE001 — surfaces as INTERNAL
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                if details.method == CALL_METHOD:
+                    return grpc.unary_unary_rpc_method_handler(
+                        call,
+                        request_deserializer=None,   # raw bytes
+                        response_serializer=None,
+                    )
+                return None
+
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+        )
+        self.server.add_generic_rpc_handlers((Handler(),))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        self.server.start()
+
+    def close(self):
+        self.server.stop(grace=1).wait()
+
+
+_grpc: Optional[_GrpcIngress] = None
+
+
+def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the gRPC ingress; returns the bound port."""
+    global _grpc
+    if _grpc is None:
+        _grpc = _GrpcIngress(host, port)
+    return _grpc.port
+
+
+def stop_grpc() -> None:
+    global _grpc
+    if _grpc is not None:
+        _grpc.close()
+        _grpc = None

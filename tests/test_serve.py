@@ -236,3 +236,42 @@ def test_multiplex_lru_eviction(rt):
     for mid in ("a", "b", "c", "a"):  # c evicts a (LRU size 2) -> a reloads
         loads = handle.options(multiplexed_model_id=mid).remote().result()
     assert loads == ["a", "b", "c", "a"]
+
+
+def test_grpc_ingress(rt):
+    """Generic-method gRPC ingress (reference: serve/_private/proxy.py:545
+    gRPCProxy): JSON-bytes request routed to a deployment handle."""
+    import grpc
+
+    from ray_tpu.serve.grpc_ingress import CALL_METHOD
+
+    @serve.deployment(num_replicas=2)
+    class Adder:
+        def __call__(self, a, b=0):
+            return {"sum": a + b}
+
+        def neg(self, a):
+            return -a
+
+    serve.run(Adder.bind())
+    port = serve.start_grpc()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = channel.unary_unary(CALL_METHOD)
+        reply = json.loads(stub(json.dumps({
+            "deployment": "Adder", "args": [40], "kwargs": {"b": 2},
+        }).encode()))
+        assert reply["result"] == {"sum": 42}
+
+        reply = json.loads(stub(json.dumps({
+            "deployment": "Adder", "method": "neg", "args": [7],
+        }).encode()))
+        assert reply["result"] == -7
+
+        with pytest.raises(grpc.RpcError) as ei:
+            stub(json.dumps({"deployment": "Nope", "args": []}).encode())
+        assert ei.value.code() in (grpc.StatusCode.NOT_FOUND,
+                                   grpc.StatusCode.INTERNAL)
+        channel.close()
+    finally:
+        serve.stop_grpc()
