@@ -135,8 +135,9 @@ class MedianStoppingRule:
         ]
         if len(other_means) < self.min_samples_required:
             return CONTINUE
-        other_means.sort()
-        median = other_means[len(other_means) // 2]
+        import statistics
+
+        median = statistics.median(other_means)
         mine = self._values[trial_id]
         if sum(mine) / len(mine) < median:
             return STOP

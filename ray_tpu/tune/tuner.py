@@ -59,6 +59,24 @@ class _TrialSession:
         self.queue: "queue.Queue" = queue.Queue()
         self.iteration = 0
         self.stop_requested = False
+        # report() blocks until the controller acks the event (reference:
+        # function_trainable.py _StatusReporter blocks on _continue_semaphore
+        # until the driver consumed the result).  This makes scheduler
+        # decisions synchronous with training: a STOP/exploit decision lands
+        # before the trainable takes its next step, deterministically.
+        # Sequence numbers (not a semaphore) so a backstop timeout cannot
+        # leave a stale permit that desynchronizes every later decision:
+        # the Nth report waits for the Nth ack, late acks just catch up.
+        self._reported_seq = 0
+        self._decided_seq = 0
+        self._cv = threading.Condition()
+
+    def ack(self, stop: bool = False):
+        with self._cv:
+            if stop:
+                self.stop_requested = True
+            self._decided_seq += 1
+            self._cv.notify_all()
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[str] = None):
@@ -68,7 +86,14 @@ class _TrialSession:
         ev = {"kind": "report", "metrics": out}
         if checkpoint is not None:
             ev["checkpoint"] = checkpoint
+        self._reported_seq += 1
+        seq = self._reported_seq
         self.queue.put(ev)
+        # Wait for the controller's decision on THIS report.  The timeout is
+        # a deadlock backstop (controller death); the kill path tears the
+        # actor down anyway.
+        with self._cv:
+            self._cv.wait_for(lambda: self._decided_seq >= seq, timeout=60)
         if self.stop_requested:
             raise _StopTrial()
 
@@ -149,8 +174,18 @@ class _TrialRunner:
                 return out
 
     def request_stop(self):
+        """Out-of-band stop (interrupt paths): also releases a reporter
+        blocked waiting for its ack so the stop lands immediately."""
         if self._session is not None:
-            self._session.stop_requested = True
+            self._session.ack(stop=True)
+        return True
+
+    def ack(self, stop: bool = False):
+        """Controller acknowledgment of one report event; ``stop`` rides
+        along so stop-and-ack is atomic (no window where the trainable can
+        take another step before the stop lands)."""
+        if self._session is not None:
+            self._session.ack(stop=stop)
         return True
 
 
@@ -588,21 +623,20 @@ class Tuner:
                             decision = scheduler_decision(
                                 trial, ev["metrics"]
                             )
-                            if decision == STOP:
-                                try:
-                                    trial.actor.request_stop.remote()
-                                except Exception:
-                                    pass
-                            elif (isinstance(decision, dict)
-                                  and decision.get("decision") == "exploit"):
+                            stop = decision == STOP
+                            if (isinstance(decision, dict)
+                                    and decision.get("decision") == "exploit"):
                                 # PBT: stop, then relaunch from the source
                                 # trial's checkpoint with perturbed config
                                 # (reference: pbt.py _exploit).
                                 trial.pending_exploit = decision
-                                try:
-                                    trial.actor.request_stop.remote()
-                                except Exception:
-                                    pass
+                                stop = True
+                            # Every report must be acked — the trainable is
+                            # blocked in report() until the decision lands.
+                            try:
+                                trial.actor.ack.remote(stop=stop)
+                            except Exception:
+                                pass
                         elif ev["kind"] == "done":
                             if trial.pending_exploit is not None \
                                     and ev["status"] == STOPPED:

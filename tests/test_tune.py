@@ -307,10 +307,56 @@ def test_tuner_with_searcher(rt, tmp_path):
     assert results.get_best_result().metrics["value"] == 10
 
 
+def test_pbt_exploit_decision_controlled_ordering():
+    """Deterministic PBT unit test: feed reports in a fixed order (no actors,
+    no timing) and assert the exact exploit decision — the bottom-quantile
+    trial clones the top trial's latest checkpoint and gets a mutated config
+    (reference: pbt.py _exploit/_explore semantics)."""
+    from ray_tpu.tune.schedulers import PopulationBasedTraining
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.1, 0.5, 1.0]}, seed=7,
+    )
+    pbt.on_trial_add("hi", {"lr": 1.0}, "/tmp/hi")
+    pbt.on_trial_add("lo", {"lr": 0.1}, "/tmp/lo")
+    # The high-lr trial reports first, registering checkpoints at every step.
+    for t in (1, 2):
+        assert pbt.on_result(
+            "hi", {"score": 1.0 * t, "training_iteration": t},
+            checkpoint=f"/ck/hi{t}", config={"lr": 1.0},
+        ) == CONTINUE
+    # lo's t=1 report is below the perturbation interval: no decision yet.
+    assert pbt.on_result(
+        "lo", {"score": 0.1, "training_iteration": 1},
+        checkpoint="/ck/lo1", config={"lr": 0.1},
+    ) == CONTINUE
+    # At t=2 lo is the strict minimum of a 2-trial population -> bottom
+    # quantile -> must exploit hi's latest checkpoint.
+    decision = pbt.on_result(
+        "lo", {"score": 0.2, "training_iteration": 2},
+        checkpoint="/ck/lo2", config={"lr": 0.1},
+    )
+    assert isinstance(decision, dict) and decision["decision"] == "exploit"
+    assert decision["source"] == "hi"
+    assert decision["restore_from"] == "/ck/hi2"
+    assert decision["config"]["lr"] in (0.1, 0.5, 1.0)  # mutated from hi's
+    assert pbt.num_exploits == 1
+    # hi itself must never exploit: it is the top quantile.
+    assert pbt.on_result(
+        "hi", {"score": 4.0, "training_iteration": 4},
+        checkpoint="/ck/hi4", config={"lr": 1.0},
+    ) == CONTINUE
+
+
 def test_pbt_exploits_better_trial(rt, tmp_path):
-    """PBT: low-lr trials clone the high-lr trial's checkpoint and adopt a
-    perturbed lr, so every survivor ends near the best score (reference:
-    pbt.py — exploit copies weights, explore perturbs hyperparams)."""
+    """PBT through the real Tuner: trials run strictly sequentially
+    (max_concurrent_trials=1) so every scheduler decision point is fully
+    determined — the two high-lr trials finish first (score 20), then each
+    low-lr trial is the strict population minimum at its first perturbation
+    interval and MUST exploit a finished trial's step-20 checkpoint
+    (reference: pbt.py — exploit copies weights, explore perturbs
+    hyperparams)."""
     import json
     import os
 
@@ -323,18 +369,24 @@ def test_pbt_exploits_better_trial(rt, tmp_path):
             with open(os.path.join(ckpt, "state.json")) as f:
                 state = json.load(f)
             step, score = state["step"], state["score"]
-        while step < 20:
-            import time as _time
 
-            _time.sleep(0.1)  # slow enough that controller polls interleave
-            score += config["lr"]  # higher lr is strictly better here
-            step += 1
+        def save():
             d = os.path.join(tune.get_trial_dir(), f"ckpt_{step}")
             os.makedirs(d, exist_ok=True)
             with open(os.path.join(d, "state.json"), "w") as f:
                 json.dump({"step": step, "score": score}, f)
+            return d
+
+        while step < 20:
+            score += config["lr"]  # higher lr is strictly better here
+            step += 1
             tune.report({"score": score, "training_iteration": step},
-                        checkpoint=d)
+                        checkpoint=save())
+        # A trial restored at step 20 skips the loop entirely: it must still
+        # surface its inherited state so PBT quantiles and later exploit
+        # sources see the post-exploit score/checkpoint.
+        tune.report({"score": score, "training_iteration": step},
+                    checkpoint=save())
         return None
 
     pbt = PopulationBasedTraining(
@@ -343,10 +395,10 @@ def test_pbt_exploits_better_trial(rt, tmp_path):
     )
     results = tune.Tuner(
         trainable,
-        param_space={"lr": tune.grid_search([0.1, 0.1, 1.0, 1.0])},
+        param_space={"lr": tune.grid_search([1.0, 1.0, 0.1, 0.1])},
         tune_config=tune.TuneConfig(
             metric="score", mode="max", scheduler=pbt,
-            max_concurrent_trials=4,
+            max_concurrent_trials=1,
         ),
         run_config=ray_tpu.train.RunConfig(
             name="pbt_exp", storage_path=str(tmp_path)
@@ -354,7 +406,10 @@ def test_pbt_exploits_better_trial(rt, tmp_path):
     ).fit()
     best = results.get_best_result().metrics["score"]
     assert best >= 20 * 1.0 - 1e-6  # the lr=1.0 line reaches 20.0
-    assert pbt.num_exploits >= 1
-    # An exploited lr=0.1 trial must beat what lr=0.1 alone could score.
+    # Trial 2 (second lr=1.0: bottom of a 2-trial population at t=2) and
+    # both lr=0.1 trials are each forced to exploit once.
+    assert pbt.num_exploits >= 2
+    # Every exploited trial inherits a finished step-20 checkpoint, so no
+    # trial can end anywhere near what lr=0.1 alone could score.
     scores = sorted(r.metrics.get("score", 0.0) for r in results)
     assert scores[1] > 20 * 0.1 + 1e-6, scores
