@@ -105,6 +105,12 @@ if available:
     copy = _ext.copy
     prefault = _ext.prefault
     wait_seq = _ext.wait_seq
+    store_seq = getattr(_ext, "store_seq", None)
+    if store_seq is None:  # stale cached .so without the symbol
+        def store_seq(buf, offset: int, value: int) -> None:  # type: ignore[misc]
+            import struct
+
+            struct.pack_into("<Q", buf, offset, value)
 else:
     def copy(dest, src, nthreads: int = 0) -> int:  # type: ignore[misc]
         m = memoryview(src)
@@ -118,6 +124,11 @@ else:
 
     def prefault(dest, nthreads: int = 0) -> int:  # type: ignore[misc]
         return 0
+
+    def store_seq(buf, offset: int, value: int) -> None:  # type: ignore[misc]
+        import struct
+
+        struct.pack_into("<Q", buf, offset, value)
 
     def wait_seq(buf, timeout_s: float, want_unread: int) -> bool:  # type: ignore[misc]
         import struct

@@ -194,6 +194,30 @@ static PyObject *py_wait_seq(PyObject *self, PyObject *args) {
     Py_RETURN_FALSE;
 }
 
+/* Atomic release store of a u64 header word.  Pairs with wait_seq's acquire
+ * loads: on x86_64/aarch64 a plain aligned store happens to be atomic, but
+ * mixing plain stores with atomic loads is UB-adjacent and can tear on other
+ * architectures — all header publishes go through here instead. */
+static PyObject *py_store_seq(PyObject *self, PyObject *args) {
+    PyObject *buf_obj;
+    Py_ssize_t offset;
+    unsigned long long value;
+    if (!PyArg_ParseTuple(args, "OnK", &buf_obj, &offset, &value))
+        return NULL;
+    Py_buffer buf;
+    if (PyObject_GetBuffer(buf_obj, &buf, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    if (offset < 0 || offset + 8 > buf.len || (offset & 7) != 0) {
+        PyBuffer_Release(&buf);
+        PyErr_SetString(PyExc_ValueError, "offset must be 8-aligned and in range");
+        return NULL;
+    }
+    uint64_t *p = (uint64_t *)((char *)buf.buf + offset);
+    __atomic_store_n(p, (uint64_t)value, __ATOMIC_RELEASE);
+    PyBuffer_Release(&buf);
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef methods[] = {
     {"copy", py_copy, METH_VARARGS,
      "copy(dest, src, nthreads=0) -> bytes copied.  Parallel memcpy with the "
@@ -204,6 +228,9 @@ static PyMethodDef methods[] = {
      "wait_seq(buf, timeout_s, want_unread) -> bool.  Spin-then-sleep wait "
      "on an SPSC [write_seq, read_seq] header; True when satisfied, False "
      "on timeout."},
+    {"store_seq", py_store_seq, METH_VARARGS,
+     "store_seq(buf, offset, value).  Atomic release store of a u64 header "
+     "word (pairs with wait_seq's acquire loads)."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -441,6 +441,17 @@ class Worker:
                 for root in pymod_roots:
                     if root in sys.path:
                         sys.path.remove(root)
+                if pymod_roots:
+                    # Evict modules imported from the py_modules roots: a
+                    # pooled worker may later receive a DIFFERENT version of
+                    # the same module name (distinct content-addressed root),
+                    # and a stale sys.modules hit would silently run old
+                    # code — and leak shipped modules to env-less tasks.
+                    for name, mod in list(sys.modules.items()):
+                        f = getattr(mod, "__file__", None) or ""
+                        if any(f.startswith(r + os.sep) or f == r
+                               for r in pymod_roots):
+                            del sys.modules[name]
             if injected is not None:
                 from ray_tpu.util import tracing
 

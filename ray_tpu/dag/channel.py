@@ -39,14 +39,18 @@ class ShmChannel:
         return _HDR.unpack_from(self._view, 0)
 
     def _set_write(self, seq: int, length: int):
+        from ray_tpu import _native
+
         struct.pack_into("<Q", self._view, 16, length)
-        # write_seq LAST: it publishes the payload (x86/ARM store ordering
-        # through the coherent shm mapping; Python's GIL serializes our own
-        # stores).
-        struct.pack_into("<Q", self._view, 0, seq)
+        # write_seq LAST, via an atomic release store: it publishes the
+        # payload to the peer's acquire loads in wait_seq (a plain store
+        # happens to be atomic on x86_64/aarch64 but may tear elsewhere).
+        _native.store_seq(self._mm, 0, seq)
 
     def _set_read(self, seq: int):
-        struct.pack_into("<Q", self._view, 8, seq)
+        from ray_tpu import _native
+
+        _native.store_seq(self._mm, 8, seq)
 
     def _wait(self, want_unread: bool, timeout: float):
         """Block until the channel has (reader) / lacks (writer) an unread

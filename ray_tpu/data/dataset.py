@@ -262,7 +262,11 @@ def _zip_spans(left_spans: List[tuple], right_spans: List[tuple]) -> Block:
     lcols, rcols = left.to_numpy(), right.to_numpy()
     out = dict(lcols)
     for k, v in rcols.items():
-        out[k if k not in out else f"{k}_1"] = v
+        name, i = k, 1
+        while name in out:  # probe _1, _2, ... until free — never overwrite
+            name = f"{k}_{i}"
+            i += 1
+        out[name] = v
     return Block.from_batch(out)
 
 

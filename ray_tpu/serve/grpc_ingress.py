@@ -34,7 +34,16 @@ class _GrpcIngress:
 
         from .handle import DeploymentHandle
 
-        handles: Dict[tuple, DeploymentHandle] = {}
+        # LRU-bounded: one entry per (deployment, method, model_id) route;
+        # unbounded model-id fan-out must not grow the dict forever.  The
+        # lock guards the OrderedDict against the gRPC thread pool
+        # (get/move_to_end/popitem are not a single atomic step).
+        import threading
+        from collections import OrderedDict
+
+        handles: "OrderedDict[tuple, DeploymentHandle]" = OrderedDict()
+        handles_lock = threading.Lock()
+        max_handles = 256
 
         def call(request: bytes, context):
             try:
@@ -50,7 +59,10 @@ class _GrpcIngress:
                               f"bad request body: {e}")
             key = (name, req.get("method", "__call__"),
                    req.get("multiplexed_model_id", ""))
-            h = handles.get(key)
+            with handles_lock:
+                h = handles.get(key)
+                if h is not None:
+                    handles.move_to_end(key)
             if h is None:
                 # First request for this route: verify the deployment
                 # exists so an unknown name fails fast instead of waiting
@@ -64,9 +76,14 @@ class _GrpcIngress:
                 if known is not None and name not in known:
                     context.abort(grpc.StatusCode.NOT_FOUND,
                                   f"no deployment named {name!r}")
-                h = handles[key] = DeploymentHandle(
+                h = DeploymentHandle(
                     name, key[1], multiplexed_model_id=key[2]
                 )
+                with handles_lock:
+                    h = handles.setdefault(key, h)  # lost race: reuse winner
+                    handles.move_to_end(key)
+                    while len(handles) > max_handles:
+                        handles.popitem(last=False)
             try:
                 result = h.remote(
                     *(req.get("args") or []), **(req.get("kwargs") or {})
