@@ -309,6 +309,31 @@ def bench_rllib(quick: bool):
     finally:
         algo.stop()
 
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (ImpalaConfig()
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=64,
+                         num_inflight_per_runner=2)
+            .build())
+    try:
+        algo.train()  # compile + warmup
+        sps, ups = [], []
+        for _ in range(3 if quick else 10):
+            r = algo.train()
+            sps.append(r["env_steps_per_sec"])
+            ups.append(r["learner_updates_per_sec"])
+            print(f"# impala iter: sps={r['env_steps_per_sec']:.0f} "
+                  f"ups={r['learner_updates_per_sec']:.1f} "
+                  f"stale={r['mean_weight_staleness']:.2f}",
+                  file=sys.stderr)
+        record("impala_env_steps_per_sec",
+               float(np.median(sps)), "steps/s")
+        record("impala_learner_updates_per_sec",
+               float(np.median(ups)), "updates/s")
+    finally:
+        algo.stop()
+
 
 def main():
     ap = argparse.ArgumentParser()

@@ -9,12 +9,14 @@ over a Mesh for multi-chip) and env runners are CPU actors.
 from .dqn import DQN, DQNConfig, DQNLearner
 from .env import CartPoleEnv, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
+from .impala import Impala, ImpalaConfig, ImpalaEnvRunner, ImpalaLearner
 from .learner import PPOLearner, compute_gae, init_policy, policy_forward
 from .ppo import PPO, PPOConfig
 from .replay import ReplayBuffer
 
 __all__ = [
     "PPO", "PPOConfig", "PPOLearner", "EnvRunner",
+    "Impala", "ImpalaConfig", "ImpalaEnvRunner", "ImpalaLearner",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "CartPoleEnv", "VectorEnv", "make_env", "register_env",
     "compute_gae", "init_policy", "policy_forward",

@@ -54,7 +54,7 @@ class EnvRunner:
         (reference: sample:131 returns episode lists; here the batch format
         is the tensorized equivalent)."""
         assert self._params is not None, "set_weights before sample"
-        import jax
+        from .learner import sample_categorical
 
         fwd = self._policy()
         N = self.vec.num_envs
@@ -73,18 +73,10 @@ class EnvRunner:
         obs = self.obs
         for t in range(num_steps):
             logits, value = fwd(self._params, obs)
-            logits = np.asarray(logits)
-            # Gumbel-max sampling with numpy rng (stays reproducible and
-            # avoids host<->device PRNG churn per step).
-            gumbel = -np.log(-np.log(
-                self._rng.random(logits.shape) + 1e-12) + 1e-12)
-            actions = np.argmax(logits + gumbel, axis=-1).astype(np.int32)
-            logp_all = logits - jax.nn.logsumexp(logits, axis=-1,
-                                                 keepdims=True)
+            actions, logp = sample_categorical(logits, self._rng)
             obs_buf[t] = obs
             act_buf[t] = actions
-            logp_buf[t] = np.take_along_axis(
-                np.asarray(logp_all), actions[:, None], axis=1)[:, 0]
+            logp_buf[t] = logp
             val_buf[t] = np.asarray(value)
             obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
             rew_buf[t] = rewards

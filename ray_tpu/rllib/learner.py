@@ -72,6 +72,21 @@ def policy_forward(params: PolicyParams, obs: jnp.ndarray):
     return logits, value
 
 
+def sample_categorical(logits, rng: np.random.Generator):
+    """Gumbel-max action sampling on host + logp of the chosen actions —
+    the shared per-step inference core of every env runner (numpy rng keeps
+    rollouts reproducible and avoids host<->device PRNG churn per step).
+
+    Returns (actions [N] int32, logp [N] float32)."""
+    logits = np.asarray(logits)
+    gumbel = -np.log(-np.log(rng.random(logits.shape) + 1e-12) + 1e-12)
+    actions = np.argmax(logits + gumbel, axis=-1).astype(np.int32)
+    logp_all = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    logp = np.take_along_axis(
+        np.asarray(logp_all), actions[:, None], axis=1)[:, 0]
+    return actions, logp
+
+
 def compute_gae(rewards: np.ndarray, values: np.ndarray,
                 bootstrap_values: np.ndarray, dones: np.ndarray,
                 gamma: float, lam: float):
