@@ -274,7 +274,8 @@ class Head:
             "kill_actor", "cancel_task", "get_actor_by_name", "list_named_actors",
             "worker_ready",
             "publish", "subscribe", "cluster_resources", "available_resources",
-            "next_stream_item", "list_state", "ping", "shutdown_cluster",
+            "next_stream_item", "list_state", "object_sizes",
+            "ping", "shutdown_cluster",
             "actor_restarting", "restore_object", "store_stats",
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
             "node_health_ack", "node_stats", "span",
@@ -1199,6 +1200,17 @@ class Head:
             else:
                 out.append(self._object_wire(rec, prefer))
         return {"objects": out}
+
+    async def h_object_sizes(self, conn, body):
+        """Sizes of sealed objects (None while unsealed) — lets the Data
+        executor's byte-budget backpressure learn block sizes without
+        fetching them (reference: BlockMetadata.size_bytes feeding
+        execution/resource_manager.py budgets)."""
+        out = []
+        for raw in body["object_ids"]:
+            rec = self.objects.get(ObjectID(raw))
+            out.append(rec.size if rec is not None and rec.sealed else None)
+        return {"sizes": out}
 
     async def h_wait_objects(self, conn, body):
         oids = [ObjectID(raw) for raw in body["object_ids"]]

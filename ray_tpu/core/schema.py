@@ -44,6 +44,7 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
                   ("data", _BYTES)),
     "get_objects": (("object_ids", list),),
     "wait_objects": (("object_ids", list),),
+    "object_sizes": (("object_ids", list),),
     "free_objects": (("object_ids", list),),
     "add_object_ref": (("object_ids", list),),
     "reconstruct_object": (("object_id", _BYTES),),

@@ -20,6 +20,7 @@ streaming_split feeding Train workers).  TPU-first design choices:
 from .block import Block
 from .context import DataContext
 from .dataset import (
+    ActorPoolStrategy,
     Dataset,
     from_arrow,
     from_items,
@@ -35,7 +36,7 @@ from .dataset import (
 from .iterator import DataIterator
 
 __all__ = [
-    "Block", "DataContext", "Dataset", "DataIterator",
+    "ActorPoolStrategy", "Block", "DataContext", "Dataset", "DataIterator",
     "from_arrow", "from_items", "from_numpy", "from_pandas",
     "range", "range_tensor", "read_csv", "read_images", "read_json",
     "read_parquet",

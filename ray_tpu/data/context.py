@@ -23,6 +23,17 @@ class DataContext:
     default_num_blocks: int = 8
     # Rows per batch when iter_batches is not given a batch_size.
     default_batch_size: int = 256
+    # Byte budget for in-flight blocks: the executor shrinks its task
+    # window so (in-flight blocks x learned mean block size) stays under
+    # this bound (reference: execution/backpressure_policy/ +
+    # resource_manager.py budgets).  None disables byte-based backpressure.
+    max_in_flight_bytes: "int | None" = 256 * 1024 * 1024
+    # The byte budget never shrinks the window below this floor (keeps the
+    # pipeline from collapsing to serial on one huge block).
+    min_execution_window: int = 2
+    # Stats of the most recent plan execution in this process:
+    # {"peak_in_flight": int, "submitted": int, "effective_window_min": int}.
+    last_execution_stats: dict = dataclasses.field(default_factory=dict)
 
     _current = None
 

@@ -33,12 +33,16 @@ Source = Any
 Op = Callable[[Block], Block]
 
 
-@ray_tpu.remote
-def _exec_part(source: Source, ops: List[Op]) -> Block:
+def _exec_part_body(source: Source, ops: List[Op]) -> Block:
     block = source() if callable(source) else source
     for op in ops:
         block = op(block)
     return block
+
+
+@ray_tpu.remote
+def _exec_part(source: Source, ops: List[Op]) -> Block:
+    return _exec_part_body(source, ops)
 
 
 @ray_tpu.remote
@@ -113,7 +117,7 @@ class GroupedDataset:
     def _run(self, col: Optional[str], kind: str) -> "Dataset":
         partials = ray_tpu.get([
             _part_group_agg.remote(src, ops, self._key, col, kind)
-            for src, ops in self._ds._parts
+            for src, ops in self._ds._plan_parts()
         ])
         merged: dict = {}
         for part in partials:
@@ -181,6 +185,59 @@ class GroupedDataset:
             return Block.concat(pieces) if pieces else Block.from_batch({})
 
         return Dataset([(apply.remote(refs), [])])
+
+
+@ray_tpu.remote
+def _sample_column(block: Block, key: str, k: int) -> np.ndarray:
+    """Up to k evenly-spaced sample values of one block's sort column
+    (block ref resolves at the task boundary; reference:
+    planner/exchange/sort_task_spec.py sample_boundaries)."""
+    arr = block.to_numpy()[key]
+    if len(arr) <= k:
+        return np.asarray(arr)
+    idx = np.linspace(0, len(arr) - 1, k).astype(np.int64)
+    return np.asarray(arr)[idx]
+
+
+@ray_tpu.remote
+def _range_partition(block: Block, key: str, bounds: List) -> List[Block]:
+    """Split one block into len(bounds)+1 sub-blocks by sort-key range
+    (submitted with num_returns so each range lands in its own object)."""
+    arr = np.asarray(block.to_numpy()[key])
+    which = np.searchsorted(np.asarray(bounds), arr, side="right")
+    return [block.take_rows(np.flatnonzero(which == j))
+            for j in builtins.range(len(bounds) + 1)]
+
+
+@ray_tpu.remote
+def _sort_range(refs: List[Any], key: str, descending: bool) -> Block:
+    """Concat one range's partitions from every input block and sort it —
+    each output task holds only its range, never the whole dataset."""
+    block = Block.concat([ray_tpu.get(r) for r in refs])
+    if block.num_rows == 0:
+        return block  # a range can be empty (all-duplicate sample bounds)
+    order = np.argsort(np.asarray(block.to_numpy()[key]), kind="stable")
+    if descending:
+        order = order[::-1]
+    return block.take_rows(order)
+
+
+@ray_tpu.remote
+def _shuffle_partition(block: Block, n_out: int, seed) -> List[Block]:
+    """Assign each row of one block to a uniformly random output bucket
+    (submitted with num_returns=n_out)."""
+    rng = np.random.default_rng(seed)
+    which = rng.integers(0, n_out, block.num_rows)
+    return [block.take_rows(np.flatnonzero(which == j))
+            for j in builtins.range(n_out)]
+
+
+@ray_tpu.remote
+def _shuffle_merge(refs: List[Any], seed) -> Block:
+    """Concat one output bucket's pieces and permute rows locally."""
+    block = Block.concat([ray_tpu.get(r) for r in refs])
+    rng = np.random.default_rng(seed)
+    return block.take_rows(rng.permutation(block.num_rows))
 
 
 @ray_tpu.remote
@@ -270,38 +327,197 @@ def _zip_spans(left_spans: List[tuple], right_spans: List[tuple]) -> Block:
     return Block.from_batch(out)
 
 
+class ActorPoolStrategy:
+    """Compute strategy for stateful map_batches UDFs: a fixed pool of
+    actors each instantiating the UDF class once and reusing it across
+    blocks (reference: data/_internal/execution/operators/
+    actor_pool_map_operator.py — essential for accelerator-resident or
+    expensive-to-construct preprocessing state)."""
+
+    def __init__(self, size: int = 2, *, num_cpus: float = 1.0,
+                 max_tasks_in_flight_per_actor: int = 2):
+        assert size >= 1
+        self.size = size
+        self.num_cpus = num_cpus
+        self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
+
+
+# Worker/actor-process-global cache of stateful UDF instances, keyed by the
+# op's uid: one instance per op per actor process, living as long as the
+# pool actor does (the reference's _MapWorker holds the callable the same
+# way).
+_UDF_INSTANCES: Dict[str, Any] = {}
+
+
+class _StatefulBatchOp:
+    """A picklable op wrapping a callable-class UDF.  Executed inside a pool
+    actor; the instance is constructed on first use and cached process-wide
+    under the op uid."""
+
+    def __init__(self, fn_cls, ctor_args, ctor_kwargs, batch_format: str,
+                 fn_kwargs: Optional[dict], pool: ActorPoolStrategy):
+        import uuid as _uuid
+
+        self.fn_cls = fn_cls
+        self.ctor_args = tuple(ctor_args or ())
+        self.ctor_kwargs = dict(ctor_kwargs or {})
+        self.batch_format = batch_format
+        self.fn_kwargs = fn_kwargs or {}
+        self.pool = pool  # executor routes chains containing this op
+        self.uid = _uuid.uuid4().hex
+
+    def __call__(self, block: Block) -> Block:
+        inst = _UDF_INSTANCES.get(self.uid)
+        if inst is None:
+            inst = _UDF_INSTANCES[self.uid] = self.fn_cls(
+                *self.ctor_args, **self.ctor_kwargs
+            )
+        return _apply_batch_fn(inst, block, self.batch_format,
+                               self.fn_kwargs)
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    """One actor of an ActorPoolStrategy pool: executes whole part chains
+    so stateful ops hit this process's UDF instance cache."""
+
+    def exec_part(self, source: Source, ops: List[Op]) -> Block:
+        return _exec_part_body(source, ops)
+
+    def ping(self) -> bool:
+        return True
+
+
+class _PoolManager:
+    """Per-execution actor pools: created lazily on first routed chain,
+    round-robin dispatch, torn down after every routed task completed
+    (killing earlier would kill queued tasks)."""
+
+    def __init__(self):
+        self._pools: Dict[int, List[Any]] = {}
+        self._rr: Dict[int, int] = {}
+        self._routed_refs: List[Any] = []
+
+    @staticmethod
+    def pool_of(ops: List[Op]) -> Optional[ActorPoolStrategy]:
+        for op in ops:
+            pool = getattr(op, "pool", None)
+            if pool is not None:
+                return pool
+        return None
+
+    def submit(self, source: Source, ops: List[Op],
+               pool: ActorPoolStrategy):
+        key = id(pool)
+        actors = self._pools.get(key)
+        if actors is None:
+            actors = self._pools[key] = [
+                _PoolWorker.options(num_cpus=pool.num_cpus).remote()
+                for _ in builtins.range(pool.size)
+            ]
+            self._rr[key] = 0
+        i = self._rr[key]
+        self._rr[key] = (i + 1) % len(actors)
+        ref = actors[i].exec_part.remote(source, ops)
+        # Track only still-running work (so shutdown won't kill actors with
+        # queued tasks) and prune completed refs eagerly: holding a ref pins
+        # the block in the store, which would defeat backpressure.
+        if self._routed_refs:
+            ready, _ = ray_tpu.wait(self._routed_refs,
+                                    num_returns=len(self._routed_refs),
+                                    timeout=0)
+            done = set(r.binary() for r in ready)
+            self._routed_refs = [r for r in self._routed_refs
+                                 if r.binary() not in done]
+        self._routed_refs.append(ref)
+        return ref
+
+    def shutdown(self):
+        if not self._pools:
+            return
+        try:
+            # Wait until every routed task completed before killing its
+            # actor.  No hard deadline — a slow UDF keeps its pool alive —
+            # but a stall (no completions for 600s straight) aborts.
+            while self._routed_refs:
+                n_before = len(self._routed_refs)
+                ready, rest = ray_tpu.wait(
+                    self._routed_refs, num_returns=n_before, timeout=600
+                )
+                self._routed_refs = list(rest)
+                if self._routed_refs and len(ready) == 0:
+                    import sys as _sys
+
+                    print("ray_tpu.data: pool shutdown stalled 600s with "
+                          f"{len(self._routed_refs)} tasks in flight; "
+                          "killing pool actors", file=_sys.stderr)
+                    break
+        finally:
+            for actors in self._pools.values():
+                for a in actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+            self._pools.clear()
+            self._routed_refs = []
+
+
+def _object_sizes(refs: List[Any]) -> List[Optional[int]]:
+    """Sealed sizes (None while running) via the head's object table."""
+    from ray_tpu.core.context import ctx
+
+    try:
+        reply = ctx.client.call(
+            "object_sizes",
+            {"object_ids": [r.binary() for r in refs]},
+        )
+        return reply["sizes"]
+    except Exception:
+        return [None] * len(refs)
+
+
+def _apply_batch_fn(fn, block: Block, batch_format: str,
+                    kwargs: dict) -> Block:
+    if batch_format == "numpy":
+        out = fn(block.to_numpy(), **kwargs)
+    elif batch_format == "pandas":
+        out = fn(block.to_pandas(), **kwargs)
+    elif batch_format == "pyarrow":
+        out = fn(block.to_arrow(), **kwargs)
+    else:
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+    return _coerce_batch_out(out)
+
+
+def _coerce_batch_out(out) -> Block:
+    if isinstance(out, Block):
+        return out
+    if isinstance(out, dict):
+        return Block.from_batch(out)
+    try:
+        import pandas as pd
+
+        if isinstance(out, pd.DataFrame):
+            return Block.from_batch(
+                {c: out[c].to_numpy() for c in out.columns}
+            )
+    except ImportError:
+        pass
+    import pyarrow as pa
+
+    if isinstance(out, pa.Table):
+        return Block.from_arrow(out)
+    raise TypeError(
+        f"map_batches fn must return dict/DataFrame/Table, got {type(out)}"
+    )
+
+
 def _batch_op(fn, batch_format: str, fn_kwargs: Optional[dict]) -> Op:
     kwargs = fn_kwargs or {}
 
     def op(block: Block) -> Block:
-        if batch_format == "numpy":
-            out = fn(block.to_numpy(), **kwargs)
-        elif batch_format == "pandas":
-            out = fn(block.to_pandas(), **kwargs)
-        elif batch_format == "pyarrow":
-            out = fn(block.to_arrow(), **kwargs)
-        else:
-            raise ValueError(f"unknown batch_format {batch_format!r}")
-        if isinstance(out, Block):
-            return out
-        if isinstance(out, dict):
-            return Block.from_batch(out)
-        try:
-            import pandas as pd
-
-            if isinstance(out, pd.DataFrame):
-                return Block.from_batch(
-                    {c: out[c].to_numpy() for c in out.columns}
-                )
-        except ImportError:
-            pass
-        import pyarrow as pa
-
-        if isinstance(out, pa.Table):
-            return Block.from_arrow(out)
-        raise TypeError(
-            f"map_batches fn must return dict/DataFrame/Table, got {type(out)}"
-        )
+        return _apply_batch_fn(fn, block, batch_format, kwargs)
 
     return op
 
@@ -310,14 +526,28 @@ class Dataset:
     """Lazy, immutable dataset of blocks distributed over the cluster."""
 
     def __init__(self, parts: List[tuple],
-                 counts: Optional[List[int]] = None):
+                 counts: Optional[List[int]] = None,
+                 total_rows: Optional[int] = None):
         self._parts = parts  # [(source, [op, ...]), ...]
         self._counts = counts  # per-part row counts, when known
+        # Total row count when per-part counts are unknown but the total is
+        # invariant (sort/shuffle exchanges preserve it).
+        self._total_rows = (sum(counts) if counts is not None
+                            else total_rows)
 
     # ---------------------------------------------------------- transforms
 
     def _with_op(self, op: Op) -> "Dataset":
         return Dataset([(src, ops + [op]) for src, ops in self._parts])
+
+    def _plan_parts(self) -> List[tuple]:
+        """Parts safe for direct stateless-task submission.  A chain with an
+        ActorPoolStrategy op must run on its pool (instance reuse, sizing),
+        so such plans materialize through the pool-routed executor first."""
+        if any(_PoolManager.pool_of(ops) is not None
+               for _, ops in self._parts):
+            return self.materialize()._parts
+        return self._parts
 
     def map_batches(
         self,
@@ -325,10 +555,32 @@ class Dataset:
         *,
         batch_format: str = "numpy",
         fn_kwargs: Optional[dict] = None,
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
         batch_size: Optional[int] = None,  # accepted for API parity; the
         # whole block is one batch (tasks already bound block sizes)
     ) -> "Dataset":
-        """Apply fn to batches (reference: dataset.py map_batches:383)."""
+        """Apply fn to batches (reference: dataset.py map_batches:383).
+
+        With ``compute=ActorPoolStrategy(size=n)`` and a callable-class
+        ``fn``, each pool actor constructs the UDF once and reuses it
+        across blocks — the stateful-UDF path (reference:
+        actor_pool_map_operator.py)."""
+        if compute is not None:
+            if not isinstance(fn, type):
+                raise TypeError(
+                    "compute=ActorPoolStrategy requires a callable CLASS "
+                    "(constructed once per pool actor); got "
+                    f"{type(fn).__name__}"
+                )
+            return self._with_op(_StatefulBatchOp(
+                fn, fn_constructor_args, fn_constructor_kwargs,
+                batch_format, fn_kwargs, compute,
+            ))
+        if isinstance(fn, type):
+            # Task path: one driver-side instance shipped to tasks.
+            fn = fn(*fn_constructor_args, **(fn_constructor_kwargs or {}))
         return self._with_op(_batch_op(fn, batch_format, fn_kwargs))
 
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
@@ -402,49 +654,81 @@ class Dataset:
         return Dataset(parts, out_counts)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Global row shuffle (reference: dataset.py random_shuffle).  Each
-        output block takes a uniformly random subset of all rows; within an
-        output block rows stay grouped by source block (one gather per
-        source) — uniform assignment, locally clustered order."""
+        """Global row shuffle as a two-stage partition/merge exchange
+        (reference: all-to-all shuffle in
+        data/_internal/planner/exchange/shuffle_task_spec.py).  Stage 1:
+        one task per input block assigns each row to a uniformly random
+        output bucket (num_returns fan-out — no driver-side permutation,
+        no global gather).  Stage 2: one task per output block concats its
+        bucket from every input and permutes locally.  Peak task state is
+        one block, so this survives datasets no single worker could hold."""
         refs, counts = self._materialize_refs()
-        total = sum(counts)
         n_out = max(len(refs), 1)
-        rng = np.random.default_rng(seed)
-        perm = rng.permutation(total)
-        starts = np.cumsum([0] + counts)
-        bounds = [total * i // n_out for i in builtins.range(n_out + 1)]
+        if seed is not None:
+            base = seed
+        else:
+            import os as _os
+
+            base = int.from_bytes(_os.urandom(8), "little")
+        if n_out == 1:
+            return Dataset(
+                [(_shuffle_merge.remote(refs, (base, 1, 0)), [])],
+                [sum(counts)],
+            )
+        part_lists = [
+            _shuffle_partition.options(num_returns=n_out).remote(
+                ref, n_out, (base, 0, i))
+            for i, ref in enumerate(refs)
+        ]
         parts: List[tuple] = []
-        out_counts: List[int] = []
         for j in builtins.range(n_out):
-            mine = perm[bounds[j]:bounds[j + 1]]
-            pieces = []
-            for i, ref in enumerate(refs):
-                local = mine[(mine >= starts[i]) & (mine < starts[i + 1])]
-                if len(local):
-                    sel = (local - starts[i]).astype(np.int64)
-                    rng.shuffle(sel)
-                    pieces.append((ref, sel))
-            parts.append((_gather_indices.remote(pieces), []))
-            out_counts.append(len(mine))
-        return Dataset(parts, out_counts)
+            bucket = [pl[j] for pl in part_lists]
+            parts.append((_shuffle_merge.remote(bucket, (base, 1, j)), []))
+        return Dataset(parts, total_rows=sum(counts))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        """Total order by one column.  Single-task sort (no range
-        partitioning yet — reference uses a sample+shuffle exchange,
-        planner/exchange/sort_task_spec.py); fine for datasets that fit one
-        worker."""
+        """Total order by one column via a sample -> range-partition ->
+        per-range sort exchange (reference:
+        planner/exchange/sort_task_spec.py): boundary values come from
+        per-block samples, every input block splits itself into ranges
+        (num_returns fan-out), and each output task sorts ONE range — no
+        task ever holds the whole dataset."""
         refs, counts = self._materialize_refs()
-
-        @ray_tpu.remote
-        def _sort_all(refs: List[Any]) -> Block:
-            block = Block.concat([ray_tpu.get(r) for r in refs])
-            arr = block.to_numpy()[key]
-            order = np.argsort(arr, kind="stable")
-            if descending:
-                order = order[::-1]
-            return block.take_rows(order)
-
-        return Dataset([(_sort_all.remote(refs), [])], [sum(counts)])
+        n_out = len(refs)
+        if n_out <= 1:
+            return Dataset(
+                [(_sort_range.remote(refs, key, descending), [])],
+                [sum(counts)],
+            )
+        samples = ray_tpu.get(
+            [_sample_column.remote(r, key, 32) for r in refs]
+        )
+        allsamp = np.sort(np.concatenate(
+            [s for s in samples if len(s)] or [np.empty(0)]
+        ))
+        if len(allsamp) == 0:
+            return Dataset(
+                [(_sort_range.remote(refs, key, descending), [])],
+                [sum(counts)],
+            )
+        bounds = [
+            allsamp[len(allsamp) * j // n_out]
+            for j in builtins.range(1, n_out)
+        ]
+        part_lists = [
+            _range_partition.options(num_returns=n_out).remote(
+                r, key, bounds)
+            for r in refs
+        ]
+        order = builtins.range(n_out)
+        if descending:
+            order = reversed(order)  # highest range first
+        parts = [
+            (_sort_range.remote([pl[j] for pl in part_lists], key,
+                                descending), [])
+            for j in order
+        ]
+        return Dataset(parts, total_rows=sum(counts))
 
     def union(self, other: "Dataset") -> "Dataset":
         return Dataset(self._parts + other._parts)
@@ -522,7 +806,7 @@ class Dataset:
         distinct set travels to the driver)."""
         partials = [p for p in ray_tpu.get(
             [_part_agg.remote(src, ops, column, "unique")
-             for src, ops in self._parts]
+             for src, ops in self._plan_parts()]
         ) if p is not None]
         seen: set = set()
         for vals, _ in partials:
@@ -579,19 +863,63 @@ class Dataset:
     def _iter_block_refs(self, window: Optional[int] = None) -> Iterator[Any]:
         """Launch part tasks with a bounded in-flight window, yielding block
         refs in plan order (the pull-based streaming executor: the consumer's
-        pace bounds cluster work — reference: streaming_executor.py:48)."""
-        window = window or DataContext.get_current().execution_window
-        pending: deque = deque()
-        for src, ops in self._parts:
-            if not ops and not callable(src):
-                # Already-materialized block: no task needed.
-                pending.append(src)
-            else:
-                pending.append(_exec_part.remote(src, ops))
-            if len(pending) >= window:
+        pace bounds cluster work — reference: streaming_executor.py:48).
+
+        Backpressure is two-dimensional (reference:
+        execution/backpressure_policy/ + resource_manager.py):
+        - task count: never more than ``execution_window`` parts in flight;
+        - bytes: the window adapts down to keep (in-flight blocks x learned
+          mean block size) under ``DataContext.max_in_flight_bytes``, with
+          block sizes learned from sealed objects via the head's object
+          table (no fetches).
+        Chains containing an ActorPoolStrategy op route to that pool's
+        actors instead of stateless tasks."""
+        cfg = DataContext.get_current()
+        max_win = window or cfg.execution_window
+        budget = cfg.max_in_flight_bytes
+        min_win = max(1, cfg.min_execution_window)
+        stats = {"peak_in_flight": 0, "submitted": 0,
+                 "effective_window_min": max_win}
+        cfg.last_execution_stats = stats
+        pools = _PoolManager()
+        sized: Dict[Any, int] = {}
+        try:
+            pending: deque = deque()
+            for src, ops in self._parts:
+                eff = max_win
+                if budget and sized:
+                    avg = sum(sized.values()) / len(sized)
+                    if avg > 0:
+                        eff = max(min_win,
+                                  min(max_win, int(budget // avg)))
+                stats["effective_window_min"] = min(
+                    stats["effective_window_min"], eff)
+                while len(pending) >= eff:
+                    yield pending.popleft()
+                pool = _PoolManager.pool_of(ops)
+                if pool is not None:
+                    ref = pools.submit(src, ops, pool)
+                elif not ops and not callable(src):
+                    ref = src  # already-materialized block: no task needed
+                else:
+                    ref = _exec_part.remote(src, ops)
+                pending.append(ref)
+                stats["submitted"] += 1
+                stats["peak_in_flight"] = max(stats["peak_in_flight"],
+                                              len(pending))
+                if budget and stats["submitted"] % 4 == 0:
+                    probe = [r for r in pending
+                             if r.binary() not in sized]
+                    if probe:
+                        # Key by id bytes, NOT the ref: holding refs here
+                        # would pin every probed block in the store.
+                        for r, sz in zip(probe, _object_sizes(probe)):
+                            if sz:
+                                sized[r.binary()] = sz
+            while pending:
                 yield pending.popleft()
-        while pending:
-            yield pending.popleft()
+        finally:
+            pools.shutdown()
 
     def iter_blocks(self) -> Iterator[Block]:
         for ref in self._iter_block_refs():
@@ -681,8 +1009,11 @@ class Dataset:
     def count(self) -> int:
         if self._counts is not None:
             return sum(self._counts)
+        if self._total_rows is not None:
+            return self._total_rows
         return sum(ray_tpu.get(
-            [_part_rows.remote(src, ops) for src, ops in self._parts]
+            [_part_rows.remote(src, ops)
+             for src, ops in self._plan_parts()]
         ))
 
     def schema(self) -> Dict[str, str]:
@@ -697,7 +1028,7 @@ class Dataset:
     def _agg(self, col: str, kind: str):
         partials = [p for p in ray_tpu.get(
             [_part_agg.remote(src, ops, col, kind)
-             for src, ops in self._parts]
+             for src, ops in self._plan_parts()]
         ) if p is not None]
         if not partials:
             return None
@@ -730,7 +1061,7 @@ class Dataset:
         (reference: dataset.py std — the same Welford-free formulation)."""
         partials = [p for p in ray_tpu.get(
             [_part_agg.remote(src, ops, col, "sumsq")
-             for src, ops in self._parts]
+             for src, ops in self._plan_parts()]
         ) if p is not None]
         if not partials:
             return None
@@ -754,7 +1085,7 @@ class Dataset:
     def mean(self, col: str):
         partials = [p for p in ray_tpu.get(
             [_part_agg.remote(src, ops, col, "sum")
-             for src, ops in self._parts]
+             for src, ops in self._plan_parts()]
         ) if p is not None]
         total = sum(v for v, _ in partials)
         n = sum(c for _, c in partials)
@@ -799,7 +1130,7 @@ class Dataset:
             _write_parquet_task.remote(
                 src, ops, os.path.join(path, f"part-{i:05d}.parquet")
             )
-            for i, (src, ops) in enumerate(self._parts)
+            for i, (src, ops) in enumerate(self._plan_parts())
         ])
 
     def write_csv(self, path: str) -> None:
@@ -810,7 +1141,7 @@ class Dataset:
             _write_csv_task.remote(
                 src, ops, os.path.join(path, f"part-{i:05d}.csv")
             )
-            for i, (src, ops) in enumerate(self._parts)
+            for i, (src, ops) in enumerate(self._plan_parts())
         ])
 
     def write_json(self, path: str) -> None:
@@ -821,7 +1152,7 @@ class Dataset:
             _write_json_task.remote(
                 src, ops, os.path.join(path, f"part-{i:05d}.json")
             )
-            for i, (src, ops) in enumerate(self._parts)
+            for i, (src, ops) in enumerate(self._plan_parts())
         ])
 
     def num_blocks(self) -> int:

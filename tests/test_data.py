@@ -388,3 +388,129 @@ def test_write_json_tensor_column(rt, tmp_path):
     assert len(rows) == 6
     assert all(isinstance(r["data"], list) and len(r["data"]) == 3
                for r in rows)
+
+
+def test_actor_pool_stateful_udf(rt):
+    """compute=ActorPoolStrategy: a callable-class UDF is constructed once
+    per pool actor and reused across blocks (reference:
+    actor_pool_map_operator.py)."""
+    from ray_tpu.data import ActorPoolStrategy
+
+    class Stateful:
+        def __init__(self, offset):
+            import os
+
+            self.offset = offset
+            self.calls = 0
+            self.pid = os.getpid()
+
+        def __call__(self, batch):
+            self.calls += 1
+            batch["id"] = batch["id"] + self.offset
+            batch["ncalls"] = np.full(len(batch["id"]), self.calls)
+            batch["pid"] = np.full(len(batch["id"]), self.pid)
+            return batch
+
+    ds = rtd.range(64, override_num_blocks=8).map_batches(
+        Stateful, compute=ActorPoolStrategy(size=2),
+        fn_constructor_args=(100,),
+    )
+    # Aggregates on a pooled plan must run through the pool, not leak the
+    # UDF into stateless task workers.
+    assert ds.count() == 64
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == [100 + i for i in range(64)]
+    pids = {r["pid"] for r in rows}
+    assert len(pids) <= 2  # exactly the pool actors, not 8 task workers
+    # Instance reuse: with 8 blocks over <=2 actors some instance saw
+    # several blocks.
+    assert max(r["ncalls"] for r in rows) >= 2
+
+
+def test_actor_pool_requires_class(rt):
+    from ray_tpu.data import ActorPoolStrategy
+
+    with pytest.raises(TypeError):
+        rtd.range(8).map_batches(
+            lambda b: b, compute=ActorPoolStrategy(size=1))
+
+
+def test_distributed_sort_range_exchange(rt):
+    """Sort runs as sample -> range-partition -> per-range sort: output
+    keeps multiple blocks (nothing gathered the whole dataset) and is
+    globally ordered across block boundaries."""
+    rng = np.random.default_rng(7)
+    vals = rng.permutation(4096).astype(np.int64)
+    ds = rtd.from_numpy(vals, "v").repartition(32).sort("v")
+    assert ds.num_blocks() == 32  # one task per range, not one big task
+    got = np.concatenate([b.to_numpy()["v"] for b in ds.iter_blocks()])
+    np.testing.assert_array_equal(got, np.sort(vals))
+    # Descending too, through the same exchange.
+    ds = rtd.from_numpy(vals, "v").repartition(8).sort(
+        "v", descending=True)
+    got = np.concatenate([b.to_numpy()["v"] for b in ds.iter_blocks()])
+    np.testing.assert_array_equal(got, np.sort(vals)[::-1])
+
+
+def test_random_shuffle_partition_exchange(rt):
+    """Shuffle is a partition/merge exchange: multiset preserved, output
+    differs from input order, every output block mixes source blocks, and
+    no driver-side global permutation exists."""
+    vals = np.arange(2048, dtype=np.int64)
+    ds = rtd.from_numpy(vals, "v").repartition(8)
+    out = ds.random_shuffle(seed=3)
+    blocks = list(out.iter_blocks())
+    assert len(blocks) == 8
+    got = np.concatenate([b.to_numpy()["v"] for b in blocks])
+    assert len(got) == 2048
+    np.testing.assert_array_equal(np.sort(got), vals)  # multiset preserved
+    assert not np.array_equal(got, vals)  # actually shuffled
+    # Each output block mixes rows from several source blocks (source
+    # block = contiguous 256-value range).
+    for b in blocks:
+        v = b.to_numpy()["v"]
+        if len(v):
+            assert len(np.unique(v // 256)) >= 2
+    # Determinism under seed.
+    got2 = np.concatenate(
+        [b.to_numpy()["v"] for b in ds.random_shuffle(seed=3).iter_blocks()]
+    )
+    np.testing.assert_array_equal(got, got2)
+    # The exchange preserves the row-count invariant without re-execution.
+    assert out.count() == 2048
+
+
+def test_byte_budget_backpressure(rt):
+    """The executor's window shrinks so in-flight blocks x mean block size
+    stays under DataContext.max_in_flight_bytes (reference:
+    backpressure_policy resource budgets)."""
+    from ray_tpu.data import DataContext
+
+    cfg = DataContext.get_current()
+    old_budget, old_window = cfg.max_in_flight_bytes, cfg.execution_window
+    try:
+        cfg.execution_window = 16
+        cfg.max_in_flight_bytes = 4 * 1024 * 1024  # 4 MiB
+
+        def make_big(batch):
+            n = len(batch["id"])
+            batch["payload"] = np.zeros((n, 1 << 17), np.float64)  # 1MiB/row
+            return batch
+
+        ds = rtd.range(24, override_num_blocks=24).map_batches(make_big)
+        total = 0
+        for b in ds.iter_blocks():
+            total += b.num_rows
+        assert total == 24
+        stats = cfg.last_execution_stats
+        assert stats["submitted"] == 24
+        # Once sizes were learned the window must have collapsed to
+        # ~budget/blocksize (= 4) instead of the configured 16.
+        assert stats["effective_window_min"] <= 5, stats
+        cfg.max_in_flight_bytes = None
+        ds2 = rtd.range(24, override_num_blocks=24).map_batches(make_big)
+        sum(b.num_rows for b in ds2.iter_blocks())
+        assert cfg.last_execution_stats["peak_in_flight"] >= 15
+    finally:
+        cfg.max_in_flight_bytes = old_budget
+        cfg.execution_window = old_window
