@@ -21,6 +21,7 @@ from .core.api import (
     is_initialized,
     kill,
     list_named_actors,
+    method,
     nodes,
     placement_group,
     put,
@@ -54,7 +55,8 @@ def __getattr__(name):
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
 
 __all__ = [
-    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "init", "shutdown", "is_initialized", "remote", "method", "get", "put",
+    "wait",
     "cancel", "kill", "get_actor", "list_named_actors", "placement_group",
     "remove_placement_group", "PlacementGroup",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",

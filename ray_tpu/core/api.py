@@ -414,7 +414,8 @@ def _process_runtime_env(renv: Optional[dict], cache: Optional[dict] = None):
     shutdown()+init() cycle re-populates the new cluster's KV (reference:
     _private/runtime_env/working_dir.py URI-cached packages;
     runtime_env/py_modules.py ships import roots the same way)."""
-    if not renv or ("working_dir" not in renv and "py_modules" not in renv):
+    if not renv or ("working_dir" not in renv and "py_modules" not in renv
+                    and "pip" not in renv):
         return renv
     cache = cache if cache is not None else {}
     out = dict(renv)
@@ -470,6 +471,55 @@ def _process_runtime_env(renv: Optional[dict], cache: Optional[dict] = None):
             ensure(key, blob)
         out.pop("py_modules")
         out["py_module_keys"] = [k for k, _ in mod_keys]
+    if "pip" in renv:
+        # Per-task/actor python-dependency isolation (reference:
+        # _private/runtime_env/pip.py + uri_cache.py): the env key is a
+        # content hash of the requirement list (+ interpreter version);
+        # local wheel/sdist files upload to the cluster KV so any node can
+        # build the env without a shared filesystem or an index.
+        pip_env = cache.get("pip_env")
+        if pip_env is None:
+            reqs = renv["pip"]
+            if isinstance(reqs, dict):
+                reqs = reqs.get("packages", [])
+            if isinstance(reqs, str):
+                with open(reqs) as f:
+                    reqs = [ln.strip() for ln in f
+                            if ln.strip()
+                            and not ln.strip().startswith("#")]
+            if not isinstance(reqs, (list, tuple)):
+                raise TypeError("runtime_env['pip'] must be a list of "
+                                "requirements, a requirements file path, "
+                                "or {'packages': [...]}")
+            normalized: List = []
+            wheels: List = []
+            for r in reqs:
+                if isinstance(r, str) and os.path.isfile(r) and \
+                        r.endswith((".whl", ".tar.gz", ".zip")):
+                    with open(r, "rb") as f:
+                        blob = f.read()
+                    digest = hashlib.sha256(blob).hexdigest()[:16]
+                    base = os.path.basename(r)
+                    wheels.append((f"pipwhl:{digest}:{base}", blob, base))
+                    normalized.append(("file", base, digest))
+                else:
+                    normalized.append(("req", str(r)))
+            import sys as _sys
+
+            env_hash = hashlib.sha256(repr(
+                (normalized, _sys.version_info[:2])
+            ).encode()).hexdigest()[:16]
+            pip_env = cache["pip_env"] = {
+                "hash": env_hash,
+                "reqs": [list(n) for n in normalized],
+                "wheel_keys": [(k, base) for k, _, base in wheels],
+                "_wheel_blobs": wheels,
+            }
+        for key, blob, _ in pip_env["_wheel_blobs"]:
+            ensure(key, blob)
+        out.pop("pip")
+        out["pip_env"] = {k: v for k, v in pip_env.items()
+                          if k != "_wheel_blobs"}
     return out
 
 
@@ -478,7 +528,26 @@ _VALID_OPTIONS = {
     "retry_exceptions", "name", "scheduling_strategy", "runtime_env",
     "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
     "namespace", "memory", "_metadata",
+    "concurrency_groups", "execute_out_of_order", "concurrency_group",
 }
+
+
+def method(**method_options):
+    """Annotate an actor method (reference: ray.method — actor.py:116
+    ActorMethod decorator).  Supported: ``concurrency_group=`` binds the
+    method to a named group declared in
+    ``@remote(concurrency_groups={...})`` (reference:
+    core_worker/transport/concurrency_group_manager.h); ``num_returns=``."""
+    allowed = {"concurrency_group", "num_returns"}
+    bad = set(method_options) - allowed
+    if bad:
+        raise ValueError(f"invalid method options: {bad}")
+
+    def decorator(fn):
+        fn.__rt_method_options__ = method_options
+        return fn
+
+    return decorator
 
 
 def _inject_trace(spec: dict) -> None:
@@ -606,21 +675,26 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._submit(self._name, args, kwargs, self._options)
 
-    def bind(self, upstream):
-        """Wire this method as a compiled-DAG step (reference:
-        dag/dag_node.py bind)."""
+    def bind(self, *upstreams):
+        """Wire this method as a compiled-DAG step; multiple upstream nodes
+        become the method's positional args (reference: dag/dag_node.py
+        bind)."""
         from ..dag.compiled import bind as _dag_bind
 
-        return _dag_bind(self, upstream)
+        return _dag_bind(self, *upstreams)
 
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names: List[str],
-                 max_task_retries: int = 0, class_name: str = ""):
+                 max_task_retries: int = 0, class_name: str = "",
+                 method_defaults: Optional[dict] = None):
         self._actor_id = actor_id
         self._method_names = method_names
         self._max_task_retries = max_task_retries
         self._class_name = class_name
+        # Per-method option defaults from @ray_tpu.method annotations
+        # (num_returns today); call-time .options() overrides them.
+        self._method_defaults = method_defaults or {}
 
     def __getattr__(self, name):
         if name.startswith("_") and name != "__rt_dag_exec_loop__":
@@ -634,7 +708,9 @@ class ActorHandle:
     def _submit(self, method_name: str, args, kwargs, options: dict):
         _ensure_init()
         task_id = TaskID.from_random()
-        num_returns = options.get("num_returns", 1)
+        defaults = self._method_defaults.get(method_name, {})
+        num_returns = options.get(
+            "num_returns", defaults.get("num_returns", 1))
         streaming = num_returns == "streaming"
         n_ret = 1 if streaming else num_returns
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(n_ret)]
@@ -651,6 +727,10 @@ class ActorHandle:
             "return_ids": [r.binary() for r in return_ids],
             "max_retries": self._max_task_retries,
         }
+        if options.get("concurrency_group") is not None:
+            # Per-call group override (reference:
+            # actor.py ActorMethod.options(concurrency_group=...)).
+            spec["concurrency_group"] = options["concurrency_group"]
         _inject_trace(spec)
         ctx.client.call_batched("submit_actor_task", spec)
         if streaming:
@@ -662,7 +742,7 @@ class ActorHandle:
         return (
             ActorHandle,
             (self._actor_id, self._method_names, self._max_task_retries,
-             self._class_name),
+             self._class_name, self._method_defaults),
         )
 
     def __repr__(self):
@@ -725,6 +805,43 @@ class ActorClass:
             "max_concurrency": o.get("max_concurrency", 1),
             "runtime_env": self._renv(),
         }
+        groups = o.get("concurrency_groups")
+        # Scan @ray_tpu.method annotations regardless of class options so a
+        # group annotation without a declared group errors loudly instead
+        # of silently losing its isolation (matching the reference).
+        method_groups: Dict[str, str] = {}
+        method_defaults: Dict[str, dict] = {}
+        for n in method_names:
+            fn = getattr(self._cls, n, None)
+            opts = getattr(fn, "__rt_method_options__", None) \
+                if fn is not None else None
+            if not opts:
+                continue
+            g = opts.get("concurrency_group")
+            if g is not None:
+                if not groups or g not in groups:
+                    raise ValueError(
+                        f"method {n!r} declares concurrency group {g!r} "
+                        "but the class does not declare it in "
+                        "@remote(concurrency_groups={...})")
+                method_groups[n] = g
+            if opts.get("num_returns") is not None:
+                method_defaults[n] = {"num_returns": opts["num_returns"]}
+        if groups:
+            # Named concurrency groups: per-group execution limits
+            # (reference: concurrency_group_manager.h).
+            if not all(isinstance(v, int) and v >= 1
+                       for v in groups.values()):
+                raise ValueError(
+                    "concurrency_groups values must be ints >= 1")
+            creation_task["concurrency_groups"] = dict(groups)
+            creation_task["method_groups"] = method_groups
+        if o.get("execute_out_of_order"):
+            # Opt-in unordered execution: tasks dispatch to threads as they
+            # arrive, so completion (and effect) order may differ from
+            # submission order (reference:
+            # out_of_order_actor_submit_queue.h).
+            creation_task["execute_out_of_order"] = True
         spec = {
             "actor_id": actor_id.binary(),
             "class_name": self.__name__,
@@ -733,12 +850,14 @@ class ActorClass:
             "max_restarts": o.get("max_restarts", cfg.default_actor_max_restarts),
             "max_task_retries": o.get("max_task_retries", 0),
             "method_names": method_names,
+            "method_defaults": method_defaults,
             "lifetime": o.get("lifetime"),
             "creation_task": creation_task,
         }
         ctx.client.call("create_actor", spec)
         return ActorHandle(
-            actor_id, method_names, spec["max_task_retries"], self.__name__
+            actor_id, method_names, spec["max_task_retries"], self.__name__,
+            method_defaults,
         )
 
     def __call__(self, *args, **kwargs):
@@ -759,6 +878,7 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
         spec["method_names"],
         spec.get("max_task_retries", 0),
         spec.get("class_name", ""),
+        spec.get("method_defaults"),
     )
 
 

@@ -124,6 +124,11 @@ class Worker:
         self.actor_id: Optional[bytes] = None
         self.max_concurrency = 1
         self.pool: Optional[ThreadPoolExecutor] = None
+        self.group_pools: Dict[str, ThreadPoolExecutor] = {}
+        self.method_groups: Dict[str, str] = {}
+        self._group_limits: Dict[str, int] = {}
+        self._async_group_sems: Dict[str, Any] = {}
+        self.out_of_order = False
         self.async_loop: Optional[asyncio.AbstractEventLoop] = None
         self.running_threads: Dict[bytes, int] = {}  # task_id -> thread ident
         self.cancelled: set = set()
@@ -221,6 +226,73 @@ class Worker:
                 sys.path.insert(0, root)
                 roots.append(root)
         return roots
+
+    def _setup_pip_env(self, pip_env: dict):
+        """Build (once, content-addressed) and activate a per-env venv
+        (reference: _private/runtime_env/pip.py — virtualenv per env hash,
+        uri_cache.py for reuse).  The venv is created with
+        --system-site-packages so framework deps stay importable; shipped
+        wheel files install with --no-index (zero-egress clusters), named
+        requirements go through pip's normal resolution.  Activation
+        prepends the venv's site-packages to sys.path and exports
+        VIRTUAL_ENV/PATH for user subprocesses; returns the site dir (the
+        caller treats it like a py_modules root: removed + module-evicted
+        on task teardown)."""
+        import fcntl
+        import subprocess
+        import venv as venv_mod
+
+        env_hash = pip_env["hash"]
+        root = os.path.join("/tmp/ray_tpu_envs", env_hash)
+        venv_dir = os.path.join(root, "venv")
+        site = os.path.join(
+            venv_dir, "lib",
+            f"python{sys.version_info[0]}.{sys.version_info[1]}",
+            "site-packages",
+        )
+        ready = os.path.join(root, "READY")  # -> (site_dir, venv_dir)
+        if not os.path.exists(ready):
+            os.makedirs(root, exist_ok=True)
+            with open(os.path.join(root, ".lock"), "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                if not os.path.exists(ready):
+                    venv_mod.create(venv_dir, system_site_packages=True,
+                                    with_pip=False, symlinks=True)
+                    os.makedirs(site, exist_ok=True)
+                    wheel_dir = os.path.join(root, "wheels")
+                    os.makedirs(wheel_dir, exist_ok=True)
+                    for key, base in pip_env.get("wheel_keys", []):
+                        blob = self.client.kv_get(key)
+                        if blob is None:
+                            raise RuntimeError(
+                                f"pip wheel {key} not found in cluster KV")
+                        with open(os.path.join(wheel_dir, base), "wb") as f:
+                            f.write(blob)
+                    args, all_local = [], True
+                    for entry in pip_env["reqs"]:
+                        if entry[0] == "file":
+                            args.append(os.path.join(wheel_dir, entry[1]))
+                        else:
+                            args.append(entry[1])
+                            all_local = False
+                    if args:
+                        cmd = [sys.executable, "-m", "pip", "install",
+                               "--quiet", "--target", site,
+                               "--find-links", wheel_dir]
+                        if all_local:
+                            cmd.append("--no-index")
+                        proc = subprocess.run(
+                            cmd + args, capture_output=True, text=True,
+                            timeout=600,
+                        )
+                        if proc.returncode != 0:
+                            raise RuntimeError(
+                                f"pip env build failed:\n{proc.stderr[-2000:]}")
+                    with open(ready, "w") as f:
+                        f.write("ok")
+        if site not in sys.path:
+            sys.path.insert(0, site)
+        return site, venv_dir
 
     def _setup_working_dir(self, key: str):
         """Extract a content-addressed working_dir archive (cached per key)
@@ -355,6 +427,17 @@ class Worker:
                 )
             if renv.get("py_module_keys"):
                 pymod_roots = self._setup_py_modules(renv["py_module_keys"])
+            if renv.get("pip_env"):
+                site, venv_dir = self._setup_pip_env(renv["pip_env"])
+                # The venv site behaves like a py_modules root from here:
+                # teardown removes it from sys.path and evicts its modules.
+                pymod_roots.append(site)
+                vbin = os.path.join(venv_dir, "bin")
+                for k, v in (("VIRTUAL_ENV", venv_dir),
+                             ("PATH", vbin + os.pathsep
+                              + os.environ.get("PATH", ""))):
+                    saved_env.setdefault(k, os.environ.get(k))
+                    os.environ[k] = v
 
             if spec.get("is_actor_creation"):
                 cls = self._load(spec["func_key"])
@@ -363,8 +446,28 @@ class Worker:
                 self.actor_id = spec["actor_id"]
                 ctx.current_actor_id = ActorID(self.actor_id)
                 self.max_concurrency = spec.get("max_concurrency", 1)
-                if self.max_concurrency > 1:
-                    self.pool = ThreadPoolExecutor(self.max_concurrency)
+                self.out_of_order = bool(spec.get("execute_out_of_order"))
+                groups = spec.get("concurrency_groups") or {}
+                self.method_groups = spec.get("method_groups") or {}
+                self._group_limits = dict(groups)
+                # Per-group executors isolate workloads: a saturated group
+                # never blocks another group's dispatch (reference:
+                # concurrency_group_manager.h — one fiber/thread pool per
+                # named group, plus the default group).
+                self.group_pools = {
+                    name: ThreadPoolExecutor(
+                        limit, thread_name_prefix=f"cg-{name}")
+                    for name, limit in groups.items()
+                }
+                if self.max_concurrency > 1 or self.group_pools \
+                        or self.out_of_order:
+                    # With groups (or unordered execution) the default
+                    # lane must also be pool-dispatched — inline execution
+                    # would block the dispatch loop and stall every group.
+                    self.pool = ThreadPoolExecutor(
+                        max(self.max_concurrency,
+                            8 if self.out_of_order else 1),
+                        thread_name_prefix="cg-default")
                 self._report_done(
                     spec,
                     returns=[self._store_value(
@@ -517,6 +620,25 @@ class Worker:
             ).start()
 
         injected = spec.get("trace_ctx")
+        # Concurrency groups apply to async methods too (reference:
+        # fiber.h — one fiber pool per group): an asyncio.Semaphore per
+        # group caps in-flight coroutines.  Created lazily on the loop
+        # thread's behalf; sized from the creation-time declaration.
+        group = spec.get("concurrency_group") \
+            or self.method_groups.get(spec.get("method_name", ""))
+        sem = None
+        if group is not None:
+            limit = self._group_limits.get(group)
+            if limit is None:
+                self._finish_err(spec, ValueError(
+                    f"unknown concurrency group {group!r}"))
+                return
+            sems = getattr(self, "_async_group_sems", None)
+            if sems is None:
+                sems = self._async_group_sems = {}
+            sem = sems.get(group)
+            if sem is None:
+                sem = sems[group] = asyncio.Semaphore(limit)
 
         async def run():
             # Tracing: the span must cover the coroutine's real lifetime and
@@ -535,7 +657,11 @@ class Worker:
                 })
                 start = time.time()
             try:
-                result = await fn(*args, **kwargs)
+                if sem is not None:
+                    async with sem:
+                        result = await fn(*args, **kwargs)
+                else:
+                    result = await fn(*args, **kwargs)
                 self._finish_ok(spec, result)
             except BaseException as e:  # noqa: BLE001
                 self._finish_err(spec, e)
@@ -584,8 +710,19 @@ class Worker:
             is_async = fn is not None and inspect.iscoroutinefunction(
                 fn.__func__ if inspect.ismethod(fn) else fn
             )
-            if self.pool is not None and is_method and not is_async:
-                self.pool.submit(self._execute, spec)
+            if is_method and not is_async:
+                group = spec.get("concurrency_group") \
+                    or self.method_groups.get(spec["method_name"])
+                gpool = self.group_pools.get(group) if group else None
+                if group and gpool is None:
+                    self._finish_err(spec, ValueError(
+                        f"unknown concurrency group {group!r}"))
+                elif gpool is not None:
+                    gpool.submit(self._execute, spec)
+                elif self.pool is not None:
+                    self.pool.submit(self._execute, spec)
+                else:
+                    self._execute(spec)
             else:
                 # Async methods dispatch to the actor loop from here without
                 # blocking, preserving queue order for sync methods.

@@ -229,3 +229,166 @@ def test_resources_not_inflated_by_actor_calls(rt):
     avail = rt.available_resources()
     total = rt.cluster_resources()
     assert avail["CPU"] <= total["CPU"] + 1e-6
+
+
+def test_concurrency_groups_isolation(rt):
+    """Named concurrency groups: a saturated slow group must not block the
+    fast group or the default lane (reference:
+    core_worker/transport/concurrency_group_manager.h)."""
+
+    @ray_tpu.remote(concurrency_groups={"slow": 1, "fast": 2})
+    class Grouped:
+        def __init__(self):
+            self.log = []
+
+        @ray_tpu.method(concurrency_group="slow")
+        def blocked(self):
+            time.sleep(5)
+            return "slow"
+
+        @ray_tpu.method(concurrency_group="fast")
+        def quick(self, i):
+            self.log.append(i)
+            return i
+
+        def default_lane(self):
+            return "default"
+
+    a = Grouped.remote()
+    # Saturate the slow group (limit 1): one running + one queued behind it.
+    slow_refs = [a.blocked.remote() for _ in range(2)]
+    t0 = time.perf_counter()
+    # Fast group and default lane must complete while slow is wedged.
+    assert ray_tpu.get([a.quick.remote(i) for i in range(8)],
+                       timeout=10) == list(range(8))
+    assert ray_tpu.get(a.default_lane.remote(), timeout=10) == "default"
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 4.0, f"fast group blocked behind slow group ({elapsed:.1f}s)"
+    assert ray_tpu.get(slow_refs, timeout=30) == ["slow", "slow"]
+
+
+def test_concurrency_group_call_time_override(rt):
+    """ActorMethod.options(concurrency_group=...) reroutes a single call
+    (reference: actor.py method options)."""
+
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class G:
+        def work(self):
+            time.sleep(3)
+            return "done"
+
+        def probe(self):
+            return "probe"
+
+    a = G.remote()
+    blocked = a.work.options(concurrency_group="io").remote()
+    # Default lane stays free while the io group is busy.
+    t0 = time.perf_counter()
+    assert ray_tpu.get(a.probe.remote(), timeout=10) == "probe"
+    assert time.perf_counter() - t0 < 2.5
+    assert ray_tpu.get(blocked, timeout=20) == "done"
+    # Unknown group errors the task, not the actor.
+    with pytest.raises(exceptions.RayTpuError):
+        ray_tpu.get(a.probe.options(concurrency_group="nope").remote(),
+                    timeout=10)
+    assert ray_tpu.get(a.probe.remote(), timeout=10) == "probe"
+
+
+def test_out_of_order_actor_execution(rt):
+    """execute_out_of_order=True: completion order follows readiness, not
+    submission order (reference: out_of_order_actor_submit_queue.h)."""
+
+    @ray_tpu.remote(execute_out_of_order=True)
+    class Unordered:
+        def slow_then_fast(self, i, delay):
+            time.sleep(delay)
+            return i
+
+    a = Unordered.remote()
+    first = a.slow_then_fast.remote(0, 4.0)   # submitted first, slow
+    second = a.slow_then_fast.remote(1, 0.0)  # submitted second, instant
+    ready, _ = ray_tpu.wait([first, second], num_returns=1, timeout=3.0)
+    # The later-submitted task must finish first.
+    assert len(ready) == 1
+    assert ray_tpu.get(ready[0]) == 1
+    assert ray_tpu.get([first, second], timeout=20) == [0, 1]
+
+
+def test_ordered_actor_stays_fifo(rt):
+    """Without the opt-in, a concurrency-1 actor still executes strictly in
+    submission order."""
+
+    @ray_tpu.remote
+    class Fifo:
+        def __init__(self):
+            self.log = []
+
+        def run(self, i, delay):
+            time.sleep(delay)
+            self.log.append(i)
+            return i
+
+        def get_log(self):
+            return self.log
+
+    a = Fifo.remote()
+    a.run.remote(0, 1.0)
+    a.run.remote(1, 0.0)
+    assert ray_tpu.get(a.get_log.remote(), timeout=15) == [0, 1]
+
+
+def test_async_methods_respect_concurrency_groups(rt):
+    """Concurrency groups cap async methods too (reference: fiber.h — one
+    fiber pool per group), and unknown groups error the task."""
+
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class AsyncSvc:
+        def __init__(self):
+            self.active = 0
+            self.peak = 0
+
+        @ray_tpu.method(concurrency_group="io")
+        async def fetch(self):
+            import asyncio
+
+            self.active += 1
+            self.peak = max(self.peak, self.active)
+            await asyncio.sleep(0.2)
+            self.active -= 1
+            return "ok"
+
+        async def peak_seen(self):
+            return self.peak
+
+    a = AsyncSvc.remote()
+    assert ray_tpu.get([a.fetch.remote() for _ in range(4)],
+                       timeout=15) == ["ok"] * 4
+    assert ray_tpu.get(a.peak_seen.remote(), timeout=10) == 1  # capped
+    with pytest.raises(exceptions.RayTpuError):
+        ray_tpu.get(a.fetch.options(concurrency_group="nope").remote(),
+                    timeout=10)
+
+
+def test_method_annotation_num_returns_and_orphan_group(rt):
+    """@ray_tpu.method(num_returns=2) splits returns without call-time
+    options; a group annotation without a class declaration errors at
+    creation (matching the reference's validation)."""
+
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    s = Splitter.remote()
+    r1, r2 = s.pair.remote()
+    assert ray_tpu.get([r1, r2], timeout=10) == [1, 2]
+
+    @ray_tpu.remote
+    class Orphan:
+        @ray_tpu.method(concurrency_group="nope")
+        def f(self):
+            return 0
+
+    with pytest.raises(ValueError, match="concurrency group"):
+        Orphan.remote()
