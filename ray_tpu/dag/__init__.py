@@ -3,13 +3,15 @@
 from .channel import ShmChannel
 from .compiled import (
     CompiledDAG,
+    DagFuture,
     DagNode,
     InputNode,
+    MultiOutputNode,
     bind,
     enable_compiled_dags,
 )
 
 __all__ = [
-    "InputNode", "DagNode", "CompiledDAG", "bind", "enable_compiled_dags",
-    "ShmChannel",
+    "InputNode", "DagNode", "MultiOutputNode", "CompiledDAG", "DagFuture",
+    "bind", "enable_compiled_dags", "ShmChannel",
 ]

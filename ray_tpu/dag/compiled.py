@@ -1,19 +1,30 @@
-"""Compiled DAGs: static actor pipelines over shm channels.
+"""Compiled DAGs: static actor graphs over shm channels.
 
 Role-equivalent to the reference's accelerated DAGs
 (reference: python/ray/dag/dag_node.py:162 experimental_compile ->
 compiled_dag_node.py:498 CompiledDAG with per-actor execution loops
-do_exec_tasks:95 and shared-memory channels): after compile, an execution
-moves data actor-to-actor through preallocated shm channels with zero
-control-plane round trips — the TPU-first analog of NCCL p2p channels is
-simply that channel payloads are host arrays headed for jax.device_put.
+do_exec_tasks:95 and shared-memory channels; execution schedules from
+dag/dag_node_operation.py): after compile, an execution moves data
+actor-to-actor through preallocated shm channels with zero control-plane
+round trips — the TPU-first analog of NCCL p2p channels is simply that
+channel payloads are host arrays headed for jax.device_put.
 
-MVP surface: bind actor methods into a chain/graph with one input and one
-output, single-node (all channel endpoints share /dev/shm).
+Graph surface (single-node; all channel endpoints share /dev/shm):
+- multi-upstream nodes (diamond joins): ``d.f.bind(b_out, c_out)`` calls
+  ``d.f(b_val, c_val)`` once both inputs arrive;
+- fan-out: one producer feeding several consumers gets one SPSC channel
+  per consumer edge;
+- multi-output DAGs: ``MultiOutputNode([x, y]).experimental_compile()``
+  returns ``[x_val, y_val]`` per execution;
+- overlapped (pipelined) execution: ``execute_async`` returns a future
+  and lets successive executions occupy different stages concurrently —
+  the per-actor loops + one-slot channels form the execution schedule
+  (each stage holds at most one unread value, so depth = #stages).
 
     with InputNode() as inp:
-        x = preprocess.process.bind(inp)
-        out = model.infer.bind(x)
+        b = left.go.bind(inp)
+        c = right.go.bind(inp)
+        out = join.merge.bind(b, c)
     dag = out.experimental_compile()
     result = dag.execute(batch)       # -> value (synchronous)
     dag.teardown()
@@ -21,10 +32,10 @@ output, single-node (all channel endpoints share /dev/shm).
 
 from __future__ import annotations
 
-import os
 import threading
 import uuid
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ..core import serialization
@@ -32,29 +43,23 @@ from .channel import ShmChannel
 
 
 class DagNode:
-    def __init__(self, upstream: Optional["DagNode"]):
-        self.upstream = upstream
+    def __init__(self, upstreams: List["DagNode"]):
+        self.upstreams = list(upstreams)
+
+    # Back-compat alias: linear chains used .upstream
+    @property
+    def upstream(self) -> Optional["DagNode"]:
+        return self.upstreams[0] if self.upstreams else None
 
     def experimental_compile(self, channel_capacity: int = 8 * 1024 * 1024):
-        chain: List[DagNode] = []
-        node: Optional[DagNode] = self
-        while node is not None:
-            chain.append(node)
-            node = node.upstream
-        chain.reverse()
-        if not isinstance(chain[0], InputNode):
-            raise ValueError("DAG must start from an InputNode")
-        steps = chain[1:]
-        if not steps or not all(isinstance(s, ClassMethodNode) for s in steps):
-            raise ValueError("DAG steps must be bound actor methods")
-        return CompiledDAG(steps, channel_capacity)
+        return CompiledDAG([self], channel_capacity)
 
 
 class InputNode(DagNode):
     """The DAG's input placeholder (reference: dag/input_node.py)."""
 
     def __init__(self):
-        super().__init__(None)
+        super().__init__([])
 
     def __enter__(self):
         return self
@@ -64,80 +69,31 @@ class InputNode(DagNode):
 
 
 class ClassMethodNode(DagNode):
-    def __init__(self, actor, method_name: str, upstream: DagNode):
-        super().__init__(upstream)
+    def __init__(self, actor, method_name: str,
+                 upstreams: List[DagNode]):
+        super().__init__(upstreams)
         self.actor = actor
         self.method_name = method_name
 
 
-def bind(actor_method, arg: DagNode) -> ClassMethodNode:
-    """`actor.method.bind(node)` — wires one pipeline step."""
-    if not isinstance(arg, DagNode):
-        raise TypeError("bind() takes the upstream DagNode")
+class MultiOutputNode(DagNode):
+    """Bundle several graph nodes as the DAG's outputs; execute() returns
+    their values as a list (reference: dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DagNode]):
+        super().__init__(list(outputs))
+        if not outputs:
+            raise ValueError("MultiOutputNode needs at least one output")
+
+
+def bind(actor_method, *args: DagNode) -> ClassMethodNode:
+    """`actor.method.bind(node, ...)` — wires one graph step; multiple
+    upstream nodes arrive as positional args of the method call."""
+    if not args or not all(isinstance(a, DagNode) for a in args):
+        raise TypeError("bind() takes upstream DagNode arguments")
     return ClassMethodNode(
-        actor_method._handle, actor_method._name, arg
+        actor_method._handle, actor_method._name, list(args)
     )
-
-
-class CompiledDAG:
-    def __init__(self, steps: List[ClassMethodNode], channel_capacity: int):
-        self._steps = steps
-        token = uuid.uuid4().hex[:12]
-        n = len(steps)
-        self._paths = [
-            f"/dev/shm/rtdag-{token}-{i}" for i in range(n + 1)
-        ]
-        self._channels = [
-            ShmChannel(p, channel_capacity, create=True) for p in self._paths
-        ]
-        # Each actor runs a dedicated exec loop reading its input channel and
-        # writing its output channel (reference: do_exec_tasks per-actor
-        # loops).  The loop call occupies one actor concurrency slot for the
-        # DAG's lifetime.
-        self._loop_refs = [
-            step.actor.__rt_dag_exec_loop__.remote(
-                step.method_name, self._paths[i], self._paths[i + 1],
-            )
-            for i, step in enumerate(self._steps)
-        ]
-        # The DAG synchronizes over shm channels, never the control plane:
-        # batched submissions must flush now or the exec loops never start.
-        from ..core.context import ctx
-
-        ctx.client._flush_submit_batch()
-        self._lock = threading.Lock()
-        self._torn_down = False
-
-    def execute(self, value: Any, timeout: float = 60.0) -> Any:
-        with self._lock:
-            if self._torn_down:
-                raise RuntimeError("DAG was torn down")
-            self._channels[0].write_bytes(
-                serialization.pack(value), timeout=timeout
-            )
-            out_ch = self._channels[-1]
-            view = out_ch.read_bytes(timeout=timeout)
-            try:
-                result = serialization.unpack(bytes(view))
-            finally:
-                view.release()
-                out_ch.done_reading()
-        if isinstance(result, _DagError):
-            raise result.error
-        return result
-
-    def teardown(self):
-        with self._lock:
-            if self._torn_down:
-                return
-            self._torn_down = True
-            self._channels[0].close_writer()
-            try:
-                ray_tpu.get(self._loop_refs, timeout=30)
-            except Exception:
-                pass
-            for ch in self._channels:
-                ch.close(unlink=True)
 
 
 class _DagError:
@@ -145,35 +101,264 @@ class _DagError:
         self.error = error
 
 
-def _dag_exec_loop(self, method_name: str, in_path: str, out_path: str):
-    """Injected actor method: the per-actor compiled-DAG execution loop."""
-    inp = ShmChannel(in_path)
-    out = ShmChannel(out_path)
+class DagFuture:
+    """Handle for one pipelined execution (reference: compiled DAG refs)."""
+
+    def __init__(self, dag: "CompiledDAG"):
+        self._dag = dag
+        self._done = False
+        self._value: Any = None
+
+    def result(self, timeout: float = 60.0) -> Any:
+        # Outputs are SPSC-ordered: resolving future N drains executions
+        # 0..N's outputs in submission order.
+        return self._dag._resolve_until(self, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, outputs: List[DagNode], channel_capacity: int):
+        if len(outputs) == 1 and isinstance(outputs[0], MultiOutputNode):
+            self._multi_output = True
+            outputs = outputs[0].upstreams
+        else:
+            self._multi_output = False
+        for out in outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise ValueError("DAG outputs must be bound actor methods")
+
+        # ---- collect the graph (DFS over upstreams) ----
+        nodes: List[DagNode] = []
+        seen: set = set()
+
+        def visit(n: DagNode):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            for up in n.upstreams:
+                visit(up)
+            nodes.append(n)
+
+        for out in outputs:
+            visit(out)
+        steps = [n for n in nodes if isinstance(n, ClassMethodNode)]
+        inputs = [n for n in nodes if isinstance(n, InputNode)]
+        if len(inputs) != 1:
+            raise ValueError("DAG must use exactly one InputNode")
+        if len(steps) + 1 != len(nodes):
+            raise ValueError("DAG nodes must be bound actor methods")
+        self._input = inputs[0]
+        self._steps = steps
+
+        # ---- one SPSC channel per edge ----
+        token = uuid.uuid4().hex[:12]
+        self._edge_paths: Dict[Tuple[int, int, int], str] = {}
+        self._all_channels: List[ShmChannel] = []
+        self._chan_by_path: Dict[str, ShmChannel] = {}
+
+        def edge_path(producer: DagNode, consumer_id: int,
+                      slot: int) -> str:
+            key = (id(producer), consumer_id, slot)
+            p = f"/dev/shm/rtdag-{token}-{len(self._edge_paths)}"
+            self._edge_paths[key] = p
+            ch = ShmChannel(p, channel_capacity, create=True)
+            self._all_channels.append(ch)
+            self._chan_by_path[p] = ch
+            return p
+
+        # Consumer-side wiring: per step, one input path per upstream slot.
+        step_in_paths: Dict[int, List[str]] = {}
+        # Driver-fed edges (InputNode consumers).
+        self._input_paths: List[str] = []
+        for step in steps:
+            ins = []
+            for slot, up in enumerate(step.upstreams):
+                p = edge_path(up, id(step), slot)
+                if isinstance(up, InputNode):
+                    self._input_paths.append(p)
+                ins.append(p)
+            step_in_paths[id(step)] = ins
+        # Driver-read output edges.
+        self._output_paths: List[str] = [
+            edge_path(out, -1, i) for i, out in enumerate(outputs)
+        ]
+        # Producer-side wiring: every edge whose producer is this step.
+        step_out_paths: Dict[int, List[str]] = {id(s): [] for s in steps}
+        for (pid, _cid, _slot), path in self._edge_paths.items():
+            if pid in step_out_paths:
+                step_out_paths[pid].append(path)
+
+        # ---- per-actor execution loops (reference: do_exec_tasks) ----
+        self._loop_refs = [
+            step.actor.__rt_dag_exec_loop__.remote(
+                step.method_name,
+                step_in_paths[id(step)],
+                step_out_paths[id(step)],
+            )
+            for step in steps
+        ]
+        # The DAG synchronizes over shm channels, never the control plane:
+        # batched submissions must flush now or the exec loops never start.
+        from ..core.context import ctx
+
+        ctx.client._flush_submit_batch()
+        # Driver endpoints reuse the creator attachments — one fd/mmap per
+        # edge, closed exactly once in teardown.
+        self._in_channels = [self._chan_by_path[p]
+                             for p in self._input_paths]
+        self._out_channels = [self._chan_by_path[p]
+                              for p in self._output_paths]
+        # Separate submit/drain locks: result() must be able to drain
+        # outputs (relieving channel backpressure) while another thread is
+        # blocked in execute_async's write — one shared lock would deadlock
+        # pipelining beyond the channel depth.
+        self._submit_lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+        self._torn_down = False
+        self._broken: Optional[str] = None
+        self._pending: deque = deque()  # DagFutures in submission order
+
+    # ---- execution ----
+
+    def _check_usable(self):
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        if self._broken:
+            raise RuntimeError(
+                f"DAG is desynchronized ({self._broken}); tear it down and "
+                "recompile")
+
+    def _read_outputs(self, timeout: float):
+        values = []
+        for i, ch in enumerate(self._out_channels):
+            try:
+                view = ch.read_bytes(timeout=timeout)
+            except Exception:
+                if i > 0:
+                    # Earlier output channels already advanced for this
+                    # execution: results would pair across executions from
+                    # now on.  Poison the DAG instead of mispairing.
+                    self._broken = "partial output read"
+                raise
+            try:
+                values.append(serialization.unpack(bytes(view)))
+            finally:
+                view.release()
+                ch.done_reading()
+        for v in values:
+            if isinstance(v, _DagError):
+                raise v.error
+        return values if self._multi_output else values[0]
+
+    def execute_async(self, value: Any, timeout: float = 60.0) -> DagFuture:
+        """Submit one execution without waiting for its result — successive
+        submissions overlap across pipeline stages (each stage's channel
+        buffers one value, so a S-stage chain runs S executions
+        concurrently; reference: compiled DAG overlapped execution
+        schedules, dag_node_operation.py).  When the pipeline is full the
+        write blocks until a result() drains an output (possible from
+        another thread: submit and drain take separate locks)."""
+        with self._submit_lock:
+            self._check_usable()
+            blob = serialization.pack(value)
+            for i, ch in enumerate(self._in_channels):
+                try:
+                    ch.write_bytes(blob, timeout=timeout)
+                except Exception:
+                    if i > 0:
+                        # Some input edges got this execution, others
+                        # didn't: joins would pair mismatched executions.
+                        self._broken = "partial input write"
+                    raise
+            fut = DagFuture(self)
+            self._pending.append(fut)
+            return fut
+
+    def _resolve_until(self, fut: DagFuture, timeout: float):
+        with self._drain_lock:
+            while not fut._done:
+                if not self._pending:
+                    raise RuntimeError("future already resolved")
+                head = self._pending.popleft()
+                try:
+                    head._value = self._read_outputs(timeout)
+                except BaseException as e:  # noqa: BLE001
+                    head._value = e
+                head._done = True
+        if isinstance(fut._value, BaseException):
+            raise fut._value
+        return fut._value
+
+    def execute(self, value: Any, timeout: float = 60.0) -> Any:
+        return self.execute_async(value, timeout).result(timeout)
+
+    def teardown(self):
+        with self._submit_lock, self._drain_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+            for ch in self._in_channels:
+                ch.close_writer()
+            try:
+                ray_tpu.get(self._loop_refs, timeout=30)
+            except Exception:
+                pass
+            for ch in self._all_channels:
+                ch.close(unlink=True)
+
+
+def _dag_exec_loop(self, method_name: str, in_paths, out_paths):
+    """Injected actor method: the per-actor compiled-DAG execution loop —
+    read one value from every input edge, apply the method, publish the
+    result on every output edge (fan-out = one SPSC channel per consumer).
+    Errors (and upstream errors) forward downstream instead of calling the
+    method, so the driver sees the root cause."""
+    if isinstance(in_paths, str):   # pre-graph linear form
+        in_paths = [in_paths]
+    if isinstance(out_paths, str):
+        out_paths = [out_paths]
+    ins = [ShmChannel(p) for p in in_paths]
+    outs = [ShmChannel(p) for p in out_paths]
     method = getattr(self, method_name)
     try:
         while True:
-            try:
-                view = inp.read_bytes(timeout=3600.0)
-            except EOFError:
-                out.close_writer()
+            values = []
+            closed = False
+            for ch in ins:
+                try:
+                    view = ch.read_bytes(timeout=3600.0)
+                except EOFError:
+                    closed = True
+                    break
+                try:
+                    values.append(serialization.unpack(bytes(view)))
+                finally:
+                    view.release()
+                    ch.done_reading()
+            if closed:
+                for out in outs:
+                    out.close_writer()
                 return "closed"
-            try:
-                value = serialization.unpack(bytes(view))
-            finally:
-                view.release()
-                inp.done_reading()
-            try:
-                result = method(value)
-            except BaseException as e:  # noqa: BLE001 — ships to the driver
-                result = _DagError(e)
-            out.write_bytes(serialization.pack(result))
+            upstream_err = next(
+                (v for v in values if isinstance(v, _DagError)), None)
+            if upstream_err is not None:
+                result = upstream_err
+            else:
+                try:
+                    result = method(*values)
+                except BaseException as e:  # noqa: BLE001 — to the driver
+                    result = _DagError(e)
+            blob = serialization.pack(result)
+            for out in outs:
+                out.write_bytes(blob)
     finally:
-        inp.close()
-        out.close()
+        for ch in ins:
+            ch.close()
+        for ch in outs:
+            ch.close()
 
 
 def enable_compiled_dags(actor_class):
     """Class decorator: make an actor class usable in compiled DAGs (adds
-    the exec-loop method; bind via `actor.method.bind(node)`)."""
+    the exec-loop method; bind via `actor.method.bind(node, ...)`)."""
     actor_class._cls.__rt_dag_exec_loop__ = _dag_exec_loop
     return actor_class

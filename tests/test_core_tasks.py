@@ -243,3 +243,70 @@ def test_runtime_env_py_modules(rt, tmp_path):
         return any("ray_tpu_pymod" in p for p in sys.path)
 
     assert rt.get(plain.remote()) is False
+
+
+def _make_wheel(tmp_path, name="isopkg", version="1.0", value=42):
+    """Build a minimal pure-python wheel offline (a wheel is just a zip
+    with a dist-info directory)."""
+    import zipfile
+
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    di = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        zf.writestr(f"{di}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{di}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\n"
+                    "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{di}/RECORD",
+                    f"{name}/__init__.py,,\n{di}/METADATA,,\n"
+                    f"{di}/WHEEL,,\n{di}/RECORD,,\n")
+    return str(whl)
+
+
+def test_runtime_env_pip_isolation(rt, tmp_path):
+    """runtime_env={'pip': [...]}: the task runs inside a content-addressed
+    venv built from the requirement list and imports a package the driver
+    cannot (reference: _private/runtime_env/pip.py + uri_cache.py)."""
+    whl = _make_wheel(tmp_path, value=42)
+    with pytest.raises(ImportError):
+        import isopkg  # noqa: F401 — the driver must NOT have it
+
+    @rt.remote(runtime_env={"pip": [whl]})
+    def inside():
+        import os
+
+        import isopkg
+
+        return isopkg.VALUE, os.environ.get("VIRTUAL_ENV", "")
+
+    value, venv = rt.get(inside.remote(), timeout=120)
+    assert value == 42
+    assert "/tmp/ray_tpu_envs/" in venv
+
+    # Isolation: a task WITHOUT the env on the same (pooled) workers must
+    # not see the package.
+    @rt.remote
+    def outside():
+        try:
+            import isopkg  # noqa: F401
+            return True
+        except ImportError:
+            return False
+
+    assert not any(rt.get([outside.remote() for _ in range(4)], timeout=60))
+
+    # Content-addressed isolation between versions: a DIFFERENT wheel for
+    # the same import name gets its own venv and its own version.
+    (tmp_path / "v2").mkdir(exist_ok=True)
+    whl2 = _make_wheel(tmp_path / "v2", value=77)
+
+    @rt.remote(runtime_env={"pip": [whl2]})
+    def inside2():
+        import isopkg
+
+        return isopkg.VALUE
+
+    assert rt.get(inside2.remote(), timeout=120) == 77
