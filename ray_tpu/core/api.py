@@ -915,8 +915,16 @@ def placement_group(
     bundles: List[Dict[str, float]],
     strategy: str = "PACK",
     name: str = "",
+    lifetime: Optional[str] = None,
 ) -> PlacementGroup:
+    """Reserve resource bundles (reference: util/placement_group.py).
+
+    ``lifetime="detached"`` decouples the group from its creator: it
+    survives driver disconnect AND head restarts (persisted in the head
+    snapshot, like detached named actors)."""
     _ensure_init()
+    if lifetime not in (None, "detached"):
+        raise ValueError("lifetime must be None or 'detached'")
     pg_id = PlacementGroupID.from_random()
     reply = ctx.client.call(
         "create_placement_group",
@@ -925,6 +933,7 @@ def placement_group(
             "bundles": bundles,
             "strategy": strategy,
             "name": name,
+            "lifetime": lifetime,
         },
     )
     pg = PlacementGroup(pg_id, bundles, strategy)

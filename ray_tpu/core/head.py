@@ -233,6 +233,12 @@ class Head:
         # Placement groups waiting for resources to free up (reference:
         # gcs_placement_group_manager queues pending PGs).
         self.pending_pgs: "Dict[PlacementGroupID, dict]" = {}
+        # Creation bodies of every live PG (reserved or pending) — the
+        # durable PG table: detached ones are replayed on head restart
+        # (reference: gcs_table_storage.h PlacementGroupTable).
+        self.pg_bodies: "Dict[PlacementGroupID, dict]" = {}
+        # Non-detached PGs are scoped to their creator's connection.
+        self.pg_owner_conn: "Dict[PlacementGroupID, int]" = {}
         self._pending_frees: Dict[int, dict] = {}
         self._free_token = 0
         self.metrics_by_pid: Dict[int, list] = {}
@@ -731,6 +737,17 @@ class Head:
         }
 
     async def _on_disconnect(self, conn: Connection):
+        # Non-detached placement groups die with their creator's connection
+        # (reference: PGs are destroyed when the creating job exits unless
+        # lifetime="detached" — gcs_placement_group_manager job scoping).
+        for pg_id in [p for p, owner in self.pg_owner_conn.items()
+                      if owner == conn.conn_id]:
+            self.pg_owner_conn.pop(pg_id, None)
+            self.pg_bodies.pop(pg_id, None)
+            self.pending_pgs.pop(pg_id, None)
+            self._notify_pg_ready(pg_id)
+            self.scheduler.remove_placement_group(pg_id)
+            self._mark_dirty()
         # A proxy driver that died mid-upload leaves unsealed segments in
         # the head store; reclaim them (gets on those ids keep blocking
         # until their own timeouts, same as a never-sealed put).
@@ -912,7 +929,18 @@ class Head:
             actor = self.actors.get(aid)
             if actor is not None and actor.state != "DEAD":
                 named[name] = actor.spec
-        snapshot = {"kv": dict(self.kv), "named_actors": named}
+        # Durable tables: KV, named/detached actor specs, and every live
+        # placement group's creation body (reserved or still pending) —
+        # the reference persists these in Redis-backed GCS tables
+        # (gcs_table_storage.h) and replays on restart.
+        # Only detached PGs are durable: a non-detached PG's owner (its
+        # driver connection) cannot survive a head restart anyway, and
+        # persisting it would leak its reservation forever.
+        pgs = {pg_id.binary(): body
+               for pg_id, body in self.pg_bodies.items()
+               if body.get("lifetime") == "detached"}
+        snapshot = {"kv": dict(self.kv), "named_actors": named,
+                    "pgs": pgs}
 
         def dump():
             import cloudpickle
@@ -940,6 +968,18 @@ class Head:
         with open(path, "rb") as f:
             state = cloudpickle.loads(f.read())
         self.kv.update(state.get("kv", {}))
+        # PGs first: restored actors may target them.  Replaying the
+        # creation body re-reserves bundles on the current node set; with
+        # no nodes registered yet the PG queues in pending_pgs and is
+        # satisfied when daemons (re)join — exactly the pending-PG path.
+        for raw, body in state.get("pgs", {}).items():
+            pg_id = PlacementGroupID(raw)
+            if pg_id in self.pg_bodies:
+                continue
+            try:
+                await self.h_create_placement_group(None, body)
+            except Exception:
+                pass
         for name, spec in state.get("named_actors", {}).items():
             if name in self.named_actors:
                 continue
@@ -2294,6 +2334,10 @@ class Head:
 
     async def h_create_placement_group(self, conn, body):
         pg_id = PlacementGroupID(body["pg_id"])
+        self.pg_bodies[pg_id] = body
+        if conn is not None and body.get("lifetime") != "detached":
+            self.pg_owner_conn[pg_id] = conn.conn_id
+        self._mark_dirty()
         strategy = body.get("strategy", "PACK")
         ok = self.scheduler.create_placement_group(
             pg_id, body["bundles"], strategy, body.get("name", "")
@@ -2353,6 +2397,9 @@ class Head:
 
     async def h_remove_placement_group(self, conn, body):
         pg_id = PlacementGroupID(body["pg_id"])
+        self.pg_bodies.pop(pg_id, None)
+        self.pg_owner_conn.pop(pg_id, None)
+        self._mark_dirty()
         self.pending_pgs.pop(pg_id, None)
         self._notify_pg_ready(pg_id)
         self.scheduler.remove_placement_group(pg_id)

@@ -233,11 +233,11 @@ class CompiledDAG:
             try:
                 view = ch.read_bytes(timeout=timeout)
             except Exception:
-                if i > 0:
-                    # Earlier output channels already advanced for this
-                    # execution: results would pair across executions from
-                    # now on.  Poison the DAG instead of mispairing.
-                    self._broken = "partial output read"
+                # The execution was already submitted: its unread output(s)
+                # would mispair with the next future.  Poison the DAG (this
+                # covers the single-output i == 0 case too — the late value
+                # still lands in the channel eventually).
+                self._broken = "output read failed/timed out"
                 raise
             try:
                 values.append(serialization.unpack(bytes(view)))
@@ -276,6 +276,21 @@ class CompiledDAG:
     def _resolve_until(self, fut: DagFuture, timeout: float):
         with self._drain_lock:
             while not fut._done:
+                if self._broken or self._torn_down:
+                    # Poisoned/closed: channels may be desynchronized or
+                    # unlinked — fail pending futures instead of draining
+                    # mispaired (or freed) values.
+                    why = ("DAG was torn down" if self._torn_down
+                           else f"DAG is desynchronized ({self._broken})")
+                    while self._pending:
+                        h = self._pending.popleft()
+                        if not h._done:
+                            h._value = RuntimeError(why)
+                            h._done = True
+                    if not fut._done:
+                        fut._value = RuntimeError(why)
+                        fut._done = True
+                    break
                 if not self._pending:
                     raise RuntimeError("future already resolved")
                 head = self._pending.popleft()
@@ -296,6 +311,13 @@ class CompiledDAG:
             if self._torn_down:
                 return
             self._torn_down = True
+            # Fail still-pending futures now: after this the channels are
+            # closed and unlinked, so a later result() must raise cleanly.
+            while self._pending:
+                h = self._pending.popleft()
+                if not h._done:
+                    h._value = RuntimeError("DAG was torn down")
+                    h._done = True
             for ch in self._in_channels:
                 ch.close_writer()
             try:

@@ -244,3 +244,126 @@ def test_collective_group_reinit_fresh_incarnation():
             time.sleep(0.3)
     finally:
         ray_tpu.shutdown()
+
+
+def test_head_kill9_restores_actors_and_pending_pg(tmp_path):
+    """Head durability v2: SIGKILL the head process mid-workload, restart
+    with the same state path — the KV, named actors, a reserved placement
+    group AND a still-pending (infeasible) placement group all survive
+    (reference: gcs_table_storage.h tables replayed from Redis on GCS
+    restart; raylets re-register and bundles re-place)."""
+    import subprocess
+    import sys
+
+    state = str(tmp_path / "head.state")
+    script = f"""
+import os, time, pickle
+import ray_tpu
+ray_tpu.init(num_cpus=2, system_config={{"head_state_path": {state!r}}})
+from ray_tpu.core.context import ctx
+
+@ray_tpu.remote
+class Durable:
+    def __init__(self, tag):
+        self.tag = tag
+    def get_tag(self):
+        return self.tag
+
+d = Durable.options(name="kill9-actor", lifetime="detached").remote("v9")
+assert ray_tpu.get(d.get_tag.remote(), timeout=30) == "v9"
+
+# One satisfiable PG and one that can't fit until the cluster grows.
+ok_pg = ray_tpu.placement_group([{{"CPU": 1}}], strategy="PACK",
+                                lifetime="detached")
+assert ok_pg.ready(timeout=30)
+big_pg = ray_tpu.placement_group([{{"CPU": 64}}], strategy="PACK",
+                                 lifetime="detached")
+ctx.client.kv_put("kill9-ok-pg", pickle.dumps(ok_pg))
+ctx.client.kv_put("kill9-big-pg", pickle.dumps(big_pg))
+time.sleep(3)  # let the periodic persist flush the dirty snapshot
+print("READY", flush=True)
+time.sleep(30)  # killed long before this expires
+"""
+    env = {k: v for k, v in os.environ.items() if k != "RT_ADDRESS"}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    # Wait for the workload to be up, then SIGKILL the head (same process).
+    deadline = time.time() + 120
+    ready = False
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "READY" in line:
+            ready = True
+            break
+        if line == "" and proc.poll() is not None:
+            break  # child died during startup: don't spin on EOF
+    if not ready:
+        proc.kill()
+        err = proc.stderr.read()
+        raise AssertionError(f"driver never became ready; stderr:\n{err}")
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    time.sleep(2)  # orphan workers exit on connection loss
+
+    rt = _fresh(num_cpus=2, system_config={"head_state_path": state})
+    try:
+        from ray_tpu.core.context import ctx
+
+        ok_pg = pickle.loads(ctx.client.kv_get("kill9-ok-pg"))
+        big_pg = pickle.loads(ctx.client.kv_get("kill9-big-pg"))
+        # Named actor was re-created from its persisted spec.
+        deadline = time.time() + 30
+        tag = None
+        while time.time() < deadline:
+            try:
+                a = rt.get_actor("kill9-actor")
+                tag = rt.get(a.get_tag.remote(), timeout=30)
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert tag == "v9"
+        # The feasible PG re-reserved bundles on the restarted node set.
+        assert ok_pg.ready(timeout=30)
+        # The infeasible PG is STILL PENDING (not lost, not satisfied).
+        assert not big_pg.ready(timeout=2)
+    finally:
+        rt.shutdown()
+
+
+def test_non_detached_pg_freed_on_driver_disconnect():
+    """A placement group without lifetime="detached" dies with its creating
+    connection, releasing its reservation (reference: PGs are job-scoped
+    unless detached)."""
+    import subprocess
+    import sys
+
+    rt = _fresh(num_cpus=2)
+    try:
+        from ray_tpu.core.context import ctx
+
+        addr = os.environ.get("RT_ADDRESS")
+        script = """
+import ray_tpu
+ray_tpu.init()  # attaches via RT_ADDRESS
+pg = ray_tpu.placement_group([{"CPU": 2}])
+assert pg.ready(timeout=30)
+print("HELD", flush=True)
+"""
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert "HELD" in out.stdout, out.stderr
+        # The second driver exited without remove_placement_group: its
+        # reservation must come back, or this PG can never be placed.
+        pg = rt.placement_group([{"CPU": 2}])
+        assert pg.ready(timeout=30), "disconnect did not free the PG"
+        assert addr  # sanity: the subprocess really attached to our head
+    finally:
+        rt.shutdown()
