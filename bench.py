@@ -92,20 +92,19 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         base = LlamaConfig.b1(remat=True, dtype=jnp.bfloat16, max_seq=2048)
-        # (batch, seq, steps, remat_policy): xla_cse (XLA-chosen activation
-        # keeping) wins when it fits; full remat is the low-memory fallback.
-        # Same tokens/step (8192) across tiers — shorter sequences spend a
-        # smaller share of time in attention (below-matmul kernel
-        # efficiency), so the large-batch/short-seq points lead (measured:
-        # 32x256 70.2%, 16x512 67.0%, 8x1024 65.7%, 4x2048 63-64%).
-        # Every config runs; the best MFU is reported with its shape.
+        # (batch, seq, steps, remat_policy).  Same tokens/step (8192)
+        # across the first four tiers.  xla_cse (XLA-chosen activation
+        # keeping) leads at short seq; cse_save_attn (xla_cse + kept flash
+        # residuals, no attention recompute in backward) matches-or-wins at
+        # long seq.  The causal diagonal-skip in the flash kernels lifted
+        # the attention-dominated tiers ~3 points (4x2048: 62.6 -> 65.6).
+        # Every tier runs and is reported; the best MFU is the headline.
         plan = [
             (32, 256, 10, "xla_cse"),
             (16, 512, 10, "xla_cse"),
             (8, 1024, 10, "xla_cse"),
-            (4, 2048, 10, "xla_cse"),
+            (4, 2048, 10, "cse_save_attn"),
             (8, 2048, 10, "full"),
-            (1, 1024, 10, "full"),
         ]
     else:
         base = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
@@ -114,6 +113,7 @@ def main():
     import dataclasses
 
     result = None
+    tiers = {}
     for batch, seq, steps, policy in plan:
         cfg = dataclasses.replace(
             base, remat_policy=policy, max_seq=max(seq, 256)
@@ -123,6 +123,7 @@ def main():
             r["batch"] = batch
             r["seq"] = seq
             r["remat_policy"] = policy
+            tiers[f"{batch}x{seq}"] = round(r["mfu"] * 100, 2)
             if result is None or r["mfu"] > result["mfu"]:
                 result = r
             if not on_tpu:
@@ -131,8 +132,6 @@ def main():
             msg = (str(e).splitlines() or [repr(e)])[0][:160]
             print(f"# bench config ({batch}x{seq},{policy}) failed: {msg}",
                   file=sys.stderr)
-        if result is not None and result["mfu"] > 0.62 and batch <= 4:
-            break  # all four seq tiers ran; skip the low-memory fallbacks
     if result is None:
         print(json.dumps({
             "metric": "llama_train_mfu", "value": 0.0, "unit": "%MFU",
@@ -154,6 +153,9 @@ def main():
         "batch": result["batch"],
         "seq": result["seq"],
         "remat_policy": result.get("remat_policy", "full"),
+        # Long-sequence tiers alongside the headline (%MFU per shape):
+        # the north-star workload resembles seq>=1024, not the headline's.
+        "tiers": tiers,
     }))
     return 0
 

@@ -342,7 +342,10 @@ def main():
     ap.add_argument("--rllib", action="store_true")
     args = ap.parse_args()
 
-    ray_tpu.init(num_cpus=8)
+    # Prestart spares: the production-head setting (absorbs fork+boot
+    # latency for actor creation); opt-in so small-host inits stay lean.
+    ray_tpu.init(num_cpus=8,
+                 system_config={"prestart_spare_workers": 2})
     bench_single_node(args.quick)
     ray_tpu.shutdown()
 

@@ -63,6 +63,13 @@ class Config:
     # fork unboundedly (reference: worker_pool.h maximum_startup_concurrency
     # bounds concurrent startup).
     worker_pool_hard_cap_multiple: int = 4
+    # Fresh (never-used) idle workers to keep pre-forked per node: actor
+    # creations grab one instantly instead of waiting out a fork+boot+
+    # register cycle (reference: worker_pool.h prestart /
+    # num_prestart_python_workers).  Opt-in (0 disables): on small hosts
+    # the spare forks tax every init; production heads enable it via
+    # system_config={"prestart_spare_workers": 2} or RT_PRESTART_SPARE_WORKERS.
+    prestart_spare_workers: int = 0
     # -- memory pressure --------------------------------------------------------
     # Kill a worker when its node's host memory usage crosses this fraction
     # (reference: src/ray/common/memory_monitor.h:52 MemoryMonitor +

@@ -439,15 +439,56 @@ class Head:
                         self._event("node_health_timeout", node=node_id.hex())
                         conn.writer.close()  # triggers node-death handling
                 # Idle reaping: task-pool workers idle beyond the window exit
-                # cleanly; demand respawns them.
+                # cleanly; demand respawns them.  Fresh (never-used) workers
+                # are exempt up to the prestart spare budget — they ARE the
+                # spare pool.
                 idle_t = cfg.idle_worker_killing_time_s
+                spares = cfg.prestart_spare_workers
+                fresh_kept: Dict[NodeID, int] = {}
                 for w in list(self.workers.values()):
-                    if (w.state == IDLE and w.conn.alive
+                    if not (w.state == IDLE and w.conn.alive
                             and now - w.last_seen > idle_t):
-                        try:
-                            await w.conn.push("shutdown", {})
-                        except Exception:
-                            pass
+                        continue
+                    if not w.used and spares > 0:
+                        kept = fresh_kept.get(w.node_id, 0)
+                        if kept < spares:
+                            fresh_kept[w.node_id] = kept + 1
+                            continue
+                    try:
+                        await w.conn.push("shutdown", {})
+                    except Exception:
+                        pass
+                # Prestart: keep the spare pool of fresh forked workers
+                # filled so actor creations skip the fork+boot+register
+                # latency (reference: worker_pool.h prestart).
+                if spares > 0:
+                    for node_id, cap in self.node_worker_caps.items():
+                        if cap <= 0:
+                            continue
+                        # Never prestart for a node whose daemon is gone
+                        # (caps outlive node death): the fallback would
+                        # fork LOCAL processes for a nonexistent node,
+                        # forever.
+                        if (node_id != self.local_node_id
+                                and node_id not in self.node_daemons):
+                            continue
+                        fresh = sum(
+                            1 for w in self.workers.values()
+                            if w.node_id == node_id and w.state == IDLE
+                            and not w.used and w.conn.alive
+                        )
+                        pending = self._spawn_pending.get(node_id, 0)
+                        live = sum(
+                            1 for w in self.workers.values()
+                            if w.node_id == node_id
+                            and w.state in (STARTING, IDLE, LEASED)
+                        )
+                        hard = max(cap, 1) * \
+                            self.config.worker_pool_hard_cap_multiple
+                        room = hard - (live + pending)
+                        for _ in range(
+                                min(spares - fresh - pending, room)):
+                            self._spawn_worker(node_id)
                 # Spawn-timeout: reclaim slots of workers that never
                 # registered so _maybe_spawn can retry.
                 for node_id, times in self._spawn_times.items():
@@ -546,6 +587,17 @@ class Head:
     async def stop(self):
         try:
             self.persist_state()
+        except Exception:
+            pass
+        # Sweep this session's node-local fn-table cache (workers populate
+        # it under /tmp/ray_tpu_fncache/<session>).
+        try:
+            import shutil
+
+            shutil.rmtree(
+                os.path.join("/tmp/ray_tpu_fncache", self.session),
+                ignore_errors=True,
+            )
         except Exception:
             pass
         self._shutdown = True
@@ -740,14 +792,17 @@ class Head:
         # Non-detached placement groups die with their creator's connection
         # (reference: PGs are destroyed when the creating job exits unless
         # lifetime="detached" — gcs_placement_group_manager job scoping).
-        for pg_id in [p for p, owner in self.pg_owner_conn.items()
-                      if owner == conn.conn_id]:
+        owned = [p for p, owner in self.pg_owner_conn.items()
+                 if owner == conn.conn_id]
+        for pg_id in owned:
             self.pg_owner_conn.pop(pg_id, None)
             self.pg_bodies.pop(pg_id, None)
             self.pending_pgs.pop(pg_id, None)
             self._notify_pg_ready(pg_id)
             self.scheduler.remove_placement_group(pg_id)
             self._mark_dirty()
+        if owned:
+            self._kick()  # freed reservations: retry pending PGs/tasks
         # A proxy driver that died mid-upload leaves unsealed segments in
         # the head store; reclaim them (gets on those ids keep blocking
         # until their own timeouts, same as a never-sealed put).

@@ -166,12 +166,43 @@ class Worker:
     def _load(self, key: str):
         obj = self.fn_cache.get(key)
         if obj is None:
-            blob = self.client.kv_get(key)
+            blob = self._load_blob_cached(key)
             if blob is None:
                 raise RuntimeError(f"function table has no entry {key}")
             obj = cloudpickle.loads(blob)
             self.fn_cache[key] = obj
         return obj
+
+    def _load_blob_cached(self, key: str):
+        """Function-table blob with a node-local content-addressed file
+        cache: an actor burst forks many fresh workers that all need the
+        same class blob — the first fetch pays the head roundtrip, the
+        rest read the session's cache dir (reference:
+        gcs_function_manager.h function table + the runtime-env URI cache
+        pattern).  Session-scoped so the head's teardown sweep bounds
+        growth and concurrent clusters/users never share a directory."""
+        import hashlib
+
+        session = getattr(self.client, "session", None) or "default"
+        cdir = os.path.join("/tmp/ray_tpu_fncache", session)
+        path = os.path.join(
+            cdir, hashlib.sha1(key.encode()).hexdigest()[:24])
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            pass
+        blob = self.client.kv_get(key)
+        if blob is not None:
+            try:
+                os.makedirs(cdir, exist_ok=True)
+                tmp = f"{path}.tmp-{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.rename(tmp, path)
+            except OSError:
+                pass
+        return blob
 
     def _resolve_args(self, spec) -> tuple:
         if spec.get("args_ref") is not None:

@@ -242,6 +242,17 @@ def llama_hidden(
             block = jax.checkpoint(
                 _block, static_argnums=(0,), prevent_cse=False
             )
+        elif config.remat_policy == "cse_save_attn":
+            # xla_cse + explicitly kept flash residuals: the backward never
+            # re-runs the attention kernel (the dominant recompute at long
+            # sequence), everything else is XLA's choice.
+            from jax.ad_checkpoint import checkpoint_policies
+
+            block = jax.checkpoint(
+                _block, static_argnums=(0,), prevent_cse=False,
+                policy=checkpoint_policies.save_only_these_names(
+                    "flash_res"),
+            )
         else:
             policy = None
             if config.remat_policy == "save_attn":
@@ -250,6 +261,18 @@ def llama_hidden(
                 policy = checkpoint_policies.save_only_these_names(
                     "flash_res"
                 )
+            elif config.remat_policy == "save_dots":
+                # Keep matmul outputs, recompute elementwise — the classic
+                # TPU sweet spot: backward skips the MXU recompute while
+                # activations stay O(dots) (reference analog: deepspeed /
+                # torch selective activation checkpointing).
+                from jax.ad_checkpoint import checkpoint_policies
+
+                policy = checkpoint_policies.checkpoint_dots
+            elif config.remat_policy == "save_dots_no_batch":
+                from jax.ad_checkpoint import checkpoint_policies
+
+                policy = checkpoint_policies.checkpoint_dots_with_no_batch_dims
             block = jax.checkpoint(
                 _block, static_argnums=(0,), policy=policy
             )

@@ -112,7 +112,19 @@ def _fwd_kernel(q_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     acc0 = jnp.zeros((bq, q_ref.shape[3]), jnp.float32)
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
+    if causal and n_kb >= 2:
+        # Skip K blocks entirely past the causal diagonal: the last q row
+        # of this block is q_global+bq-1, so only k blocks starting at or
+        # below it contribute — half the work at long sequence (fully
+        # masked q blocks, e.g. ring future chunks, run zero iterations;
+        # the merge zeroes them via lse ~ NEG_INF).  Static bound when
+        # there is a single K block: a dynamic while_loop only costs there.
+        hi = jnp.clip(
+            jax.lax.div(q_global + bq + block_k - 1, block_k), 0, n_kb
+        )
+    else:
+        hi = n_kb
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)  # fully-masked rows stay finite
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
     lse = (m + jnp.log(l)).astype(jnp.float32)
@@ -184,8 +196,17 @@ def _bwd_dq_kernel(q_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (dp - delta[:, None]) * sm_scale
         return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
 
+    n_kb = Sk // block_k
+    if causal and n_kb >= 2:
+        # Same diagonal cut as the forward: k blocks past the last q row
+        # contribute nothing to dq.
+        hi = jnp.clip(
+            jax.lax.div(q_global + bq + block_k - 1, block_k), 0, n_kb
+        )
+    else:
+        hi = n_kb
     dq = jax.lax.fori_loop(
-        0, Sk // block_k, body, jnp.zeros((bq, D), jnp.float32)
+        0, hi, body, jnp.zeros((bq, D), jnp.float32)
     )
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
@@ -223,7 +244,16 @@ def _bwd_dkv_kernel(q_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
             return dk, dv
 
-        return jax.lax.fori_loop(0, Sq // block_q, body, (dk, dv))
+        n_qb = Sq // block_q
+        if causal and n_qb >= 2:
+            # dK/dV for this k block only sees q blocks whose last row
+            # reaches the block's first column: start at the diagonal.
+            lo = jnp.clip(
+                jax.lax.div(k_idx * bk - q_off, block_q), 0, n_qb
+            )
+        else:
+            lo = 0
+        return jax.lax.fori_loop(lo, n_qb, body, (dk, dv))
 
     dk0 = jnp.zeros((bk, D), jnp.float32)
     dv0 = jnp.zeros((bk, D), jnp.float32)
