@@ -133,16 +133,24 @@ class TaskRecord:
     def shape_key(self) -> tuple:
         """Placement-equivalence key: tasks with equal keys place (or fail to
         place) identically in a given cluster state — the analog of the
-        reference's SchedulingClass (src/ray/common/task/task_spec.h)."""
-        res = self.spec.get("resources")
-        strat = self.spec.get("strategy")
-        return (
-            tuple(sorted(res.items())) if res else None,
-            tuple(sorted(
-                (k, v if not isinstance(v, (bytes, bytearray)) else bytes(v))
-                for k, v in strat.items()
-            )) if strat else None,
-        )
+        reference's SchedulingClass (src/ray/common/task/task_spec.h).
+        Memoized: the dispatch loop consults it on every queue scan, and a
+        large burst is rescanned once per completion — recomputing the
+        sorted tuples dominated scheduling CPU (observed: 1M recomputes for
+        a 2k-task burst)."""
+        cached = self.__dict__.get("_shape_key")
+        if cached is None:
+            res = self.spec.get("resources")
+            strat = self.spec.get("strategy")
+            cached = self._shape_key = (
+                tuple(sorted(res.items())) if res else None,
+                tuple(sorted(
+                    (k, v if not isinstance(v, (bytes, bytearray))
+                     else bytes(v))
+                    for k, v in strat.items()
+                )) if strat else None,
+            )
+        return cached
 
 
 class ActorRecord:
@@ -206,6 +214,12 @@ class Head:
         self.objects: Dict[ObjectID, ObjectRecord] = {}
         self.object_waiters: Dict[ObjectID, List[asyncio.Event]] = {}
         self.queued_tasks: deque = deque()  # TaskRecords ready to schedule
+        # Shape histogram of queued_tasks: lets a dispatch pass stop as
+        # soon as every shape still in the queue has already failed to
+        # place — a homogeneous 10k-task burst costs O(1) per pass instead
+        # of an O(n) rescan (reference: cluster_task_manager.h groups by
+        # SchedulingClass).
+        self.queue_shapes: Dict[tuple, int] = {}
         # Tasks committed to a node (resources held), awaiting an idle worker.
         self.node_parked: Dict[NodeID, deque] = {}
         # PGs with bundles lost to node death, awaiting re-placement.
@@ -330,7 +344,7 @@ class Head:
                     if actor is not None and actor.state == "ALIVE":
                         asyncio.ensure_future(self._drain_actor_queue(actor))
             elif task not in self.queued_tasks:
-                self.queued_tasks.append(task)
+                self._enqueue_task(task)
         self._kick()
 
     def _kick(self):
@@ -510,7 +524,7 @@ class Head:
                         and now - t.park_time > stale_after
                     ]:
                         self._unpark(task)
-                        self.queued_tasks.append(task)
+                        self._enqueue_task(task)
                         requeued = True
                 if requeued:
                     self._kick()
@@ -842,7 +856,7 @@ class Head:
             for task in self.node_parked.pop(node_id, ()):
                 if task.state == PENDING:
                     task.parked_node = None
-                    self.queued_tasks.append(task)
+                    self._enqueue_task(task)
             # Objects whose only copy lived there are gone; purge locations
             # and recompute referenced ones from lineage (reference:
             # object_recovery_manager.h:90 recovers on location loss).
@@ -1487,7 +1501,7 @@ class Head:
         self._event("task_reconstruction", task=tid.hex(),
                     object=oid.hex(), attempt=count + 1)
         if not task.pending_deps:
-            self.queued_tasks.append(task)
+            self._enqueue_task(task)
         self._kick()
         return True
 
@@ -1582,9 +1596,25 @@ class Head:
         self._register_task(task)
         self._event("task_submitted", task=task.task_id.hex(), name=body.get("name", ""))
         if not task.pending_deps:
-            self.queued_tasks.append(task)
+            self._enqueue_task(task)
             self._kick()
         return {}
+
+    def _enqueue_task(self, task: "TaskRecord", front: bool = False):
+        if front:
+            self.queued_tasks.appendleft(task)
+        else:
+            self.queued_tasks.append(task)
+        k = task.shape_key()
+        self.queue_shapes[k] = self.queue_shapes.get(k, 0) + 1
+
+    def _dequeue_shape(self, task: "TaskRecord"):
+        k = task.shape_key()
+        n = self.queue_shapes.get(k, 0) - 1
+        if n <= 0:
+            self.queue_shapes.pop(k, None)
+        else:
+            self.queue_shapes[k] = n
 
     async def _dispatch_loop(self):
         """Single dispatch pass: match queued tasks to idle workers.
@@ -1616,20 +1646,27 @@ class Head:
             failed_shapes: set = set()
             while self.queued_tasks:
                 task = self.queued_tasks.popleft()
+                self._dequeue_shape(task)
                 if task.state != PENDING:
                     continue
                 shape = task.shape_key()
                 if shape in failed_shapes:
                     requeue.append(task)
+                    if all(k in failed_shapes for k in self.queue_shapes):
+                        break  # nothing left in the queue can place
                     continue
                 node_id = self.scheduler.pick_node(task.resources, task.strategy)
                 if node_id is None:
                     failed_shapes.add(shape)
                     requeue.append(task)
+                    if all(k in failed_shapes for k in self.queue_shapes):
+                        break  # nothing left in the queue can place
                     continue
                 if not self.scheduler.acquire(node_id, task.resources, task.strategy):
                     failed_shapes.add(shape)
                     requeue.append(task)
+                    if all(k in failed_shapes for k in self.queue_shapes):
+                        break  # nothing left in the queue can place
                     continue
                 worker = self._find_idle_worker(
                     node_id, fresh=self._needs_chip_grant(task)
@@ -1659,7 +1696,10 @@ class Head:
                     requeue.append(task)
                     continue
                 made_progress = True
-            self.queued_tasks.extend(requeue)
+            # Requeue at the FRONT (reversed) so submission order within a
+            # shape survives an early-exit pass.
+            for t in reversed(requeue):
+                self._enqueue_task(t, front=True)
 
     async def _drain_parked(self):
         """Dispatch node-committed tasks to workers that have become idle.
@@ -1842,7 +1882,7 @@ class Head:
                 # fall through: actor gone, give up and record the failure
                 task.retries_left = 0
             else:
-                self.queued_tasks.append(task)
+                self._enqueue_task(task)
                 self._kick()
                 return {}
 
@@ -2092,6 +2132,7 @@ class Head:
                 self._notify_object_ready(rec.object_id)
             try:
                 self.queued_tasks.remove(task)
+                self._dequeue_shape(task)
             except ValueError:
                 pass
             self._unpark(task)  # releases node-committed resources, if any
@@ -2305,7 +2346,7 @@ class Head:
                 task.state = PENDING
                 task.worker_id = None
                 self._event("task_retry", task=task.task_id.hex())
-                self.queued_tasks.append(task)
+                self._enqueue_task(task)
             else:
                 task.state = FAILED
                 cause = (
@@ -2364,7 +2405,7 @@ class Head:
                     ct2 = TaskRecord(dict(actor.spec["creation_task"]))
                     self._register_task(ct2)
                     if not ct2.pending_deps:
-                        self.queued_tasks.append(ct2)
+                        self._enqueue_task(ct2)
                 else:
                     actor.state = "DEAD"
                     self._mark_dirty()  # drop from the snapshot

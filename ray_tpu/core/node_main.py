@@ -374,6 +374,18 @@ class NodeDaemon:
                 pass
         if self.zygote is not None:
             self.zygote.close()
+        # Sweep this node's session-scoped fn-table blob cache (workers
+        # populate /tmp/ray_tpu_fncache/<session>; the head's sweep only
+        # covers its own host's filesystem).
+        try:
+            import shutil
+
+            shutil.rmtree(
+                os.path.join("/tmp/ray_tpu_fncache", self.session),
+                ignore_errors=True,
+            )
+        except Exception:
+            pass
         self.store.shutdown()
         os._exit(0)
 

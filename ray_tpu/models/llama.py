@@ -230,52 +230,33 @@ def llama_hidden(
     )
     block = _block
     if config.remat:
-        # prevent_cse must stay True (the default): under plain jit, CSE
-        # merges the backward's recomputation with the forward compute,
-        # which silently keeps every layer's activations live — remat in
-        # name only (observed: 19 simultaneous [8,2048,5632] mlp temps).
-        if config.remat_policy == "xla_cse":
-            # prevent_cse=False lets XLA CSE forward compute with backward
-            # recomputation — effectively XLA chooses which activations to
-            # keep.  Highest MFU when it fits; the "full" policy is the
-            # low-memory fallback.
-            block = jax.checkpoint(
-                _block, static_argnums=(0,), prevent_cse=False
-            )
-        elif config.remat_policy == "cse_save_attn":
-            # xla_cse + explicitly kept flash residuals: the backward never
-            # re-runs the attention kernel (the dominant recompute at long
-            # sequence), everything else is XLA's choice.
-            from jax.ad_checkpoint import checkpoint_policies
+        # Two independent axes compose here:
+        # - prevent_cse: True keeps forward/backward recompute separate
+        #   (true remat; the default — under plain jit, CSE merging the
+        #   two silently keeps every layer's activations live, observed as
+        #   19 simultaneous [8,2048,5632] mlp temps).  False ("xla_cse")
+        #   lets XLA choose which activations to keep — highest MFU when
+        #   it fits.
+        # - policy: which values the backward may keep instead of
+        #   recomputing.  "flash_res" skips the attention recompute (the
+        #   dominant cost at long sequence); checkpoint_dots keeps matmul
+        #   outputs (the classic TPU selective-checkpointing sweet spot).
+        from jax.ad_checkpoint import checkpoint_policies as cps
 
-            block = jax.checkpoint(
-                _block, static_argnums=(0,), prevent_cse=False,
-                policy=checkpoint_policies.save_only_these_names(
-                    "flash_res"),
-            )
-        else:
-            policy = None
-            if config.remat_policy == "save_attn":
-                from jax.ad_checkpoint import checkpoint_policies
-
-                policy = checkpoint_policies.save_only_these_names(
-                    "flash_res"
-                )
-            elif config.remat_policy == "save_dots":
-                # Keep matmul outputs, recompute elementwise — the classic
-                # TPU sweet spot: backward skips the MXU recompute while
-                # activations stay O(dots) (reference analog: deepspeed /
-                # torch selective activation checkpointing).
-                from jax.ad_checkpoint import checkpoint_policies
-
-                policy = checkpoint_policies.checkpoint_dots
-            elif config.remat_policy == "save_dots_no_batch":
-                from jax.ad_checkpoint import checkpoint_policies
-
-                policy = checkpoint_policies.checkpoint_dots_with_no_batch_dims
-            block = jax.checkpoint(
-                _block, static_argnums=(0,), policy=policy
-            )
+        save_attn = cps.save_only_these_names("flash_res")
+        policy, prevent_cse = {
+            "full": (None, True),
+            "xla_cse": (None, False),
+            "save_attn": (save_attn, True),
+            "cse_save_attn": (save_attn, False),
+            "save_dots": (cps.checkpoint_dots, True),
+            "save_dots_no_batch":
+                (cps.checkpoint_dots_with_no_batch_dims, True),
+        }[config.remat_policy]
+        block = jax.checkpoint(
+            _block, static_argnums=(0,), policy=policy,
+            prevent_cse=prevent_cse,
+        )
     for i, layer in enumerate(params["layers"]):
         ll = lora_params["layers"][i] if lora_params is not None else None
         x = block(config, x, layer, cos, sin, ll)
