@@ -9,6 +9,7 @@ trainable.
 
 from .schedulers import (
     ASHAScheduler,
+    HyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
@@ -40,7 +41,8 @@ __all__ = [
     "Tuner", "TuneConfig", "TuneError", "TuneInterrupted",
     "Result", "ResultGrid", "report", "get_trial_dir", "get_checkpoint",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "sample_from", "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "sample_from", "ASHAScheduler", "HyperBandScheduler", "FIFOScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining", "Searcher", "BasicVariantGenerator",
     "ConcurrencyLimiter",
 ]

@@ -270,3 +270,68 @@ class PopulationBasedTraining:
         # the quantiles honest and their checkpoints remain valid exploit
         # sources for stragglers.
         pass
+
+
+class HyperBandScheduler:
+    """Multi-bracket successive halving (reference:
+    tune/schedulers/hyperband.py HyperBandScheduler — brackets trade off
+    exploration breadth vs per-trial budget; Li et al. 2018).
+
+    The asynchronous (infinite-horizon) variant: each trial is assigned
+    round-robin to one of ``s_max + 1`` brackets; bracket ``s`` runs ASHA
+    rungs starting at ``grace_period * eta**s`` so aggressive brackets
+    stop early and conservative brackets let trials run long.  Decisions
+    are rung-local and asynchronous — no pause/promote barrier — which is
+    the same trade the reference's ASHA docs recommend over synchronous
+    HyperBand for distributed execution.
+    """
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        max_t: int = 81,
+        grace_period: int = 1,
+        eta: float = 3,
+    ):
+        assert mode in ("min", "max")
+        if eta <= 1:
+            raise ValueError(f"eta must be > 1, got {eta}")
+        if grace_period > max_t:
+            raise ValueError(
+                f"grace_period ({grace_period}) must be <= max_t ({max_t})")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.eta = eta
+        self._brackets: List[ASHAScheduler] = []
+        s = 0
+        g = grace_period
+        while g <= max_t:
+            self._brackets.append(ASHAScheduler(
+                metric=metric, mode=mode, time_attr=time_attr,
+                max_t=max_t, grace_period=g, reduction_factor=eta,
+            ))
+            s += 1
+            g = grace_period * int(eta ** s)
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket_of(self, trial_id: str) -> ASHAScheduler:
+        idx = self._assignment.get(trial_id)
+        if idx is None:
+            idx = self._assignment[trial_id] = \
+                self._next % len(self._brackets)
+            self._next += 1
+        return self._brackets[idx]
+
+    @property
+    def num_brackets(self) -> int:
+        return len(self._brackets)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return self._bracket_of(trial_id).on_result(trial_id, result)
+
+    def on_complete(self, trial_id: str, result: Dict) -> None:
+        self._bracket_of(trial_id).on_complete(trial_id, result)

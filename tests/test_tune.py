@@ -413,3 +413,48 @@ def test_pbt_exploits_better_trial(rt, tmp_path):
     # trial can end anywhere near what lr=0.1 alone could score.
     scores = sorted(r.metrics.get("score", 0.0) for r in results)
     assert scores[1] > 20 * 0.1 + 1e-6, scores
+
+
+def test_hyperband_brackets_trade_breadth_for_budget():
+    """HyperBand assigns trials round-robin to brackets with increasing
+    grace periods: the aggressive bracket stops a weak trial at its first
+    rung while the conservative bracket lets the same trajectory run long
+    (reference: tune/schedulers/hyperband.py bracket semantics)."""
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=27,
+                            grace_period=1, eta=3)
+    assert hb.num_brackets == 4  # grace 1, 3, 9, 27
+
+    # Bracket 0 (grace 1): ascending reporters each arrive as the rung max
+    # (survive), then a weak trial reaching t=1 is cut immediately.
+    for tid, v in (("b0_a", 7.0), ("b0_b", 8.0), ("b0_c", 9.0)):
+        hb._assignment[tid] = 0
+        assert hb.on_result(tid, {"score": v, "training_iteration": 1}) \
+            == CONTINUE
+    assert hb.on_result("b0_weak",
+                        {"score": 0.1, "training_iteration": 1}) == STOP
+
+    # The SAME weak trajectory in the most conservative bracket survives
+    # until its first rung at t=27's grace (never reached here).
+    hb._assignment["b3_weak"] = 3
+    for t in (1, 3, 9):
+        assert hb.on_result(
+            "b3_weak", {"score": 0.1, "training_iteration": t}) == CONTINUE
+
+    # Round-robin assignment covers all brackets.
+    hb2 = HyperBandScheduler(metric="score", mode="max", max_t=27,
+                             grace_period=1, eta=3)
+    for i in range(8):
+        hb2.on_result(f"t{i}", {"score": 1.0, "training_iteration": 1})
+    assert set(hb2._assignment.values()) == {0, 1, 2, 3}
+
+
+def test_hyperband_rejects_degenerate_params():
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    with pytest.raises(ValueError, match="eta"):
+        HyperBandScheduler(metric="s", mode="max", eta=1)
+    with pytest.raises(ValueError, match="grace_period"):
+        HyperBandScheduler(metric="s", mode="max", grace_period=100,
+                           max_t=81)
