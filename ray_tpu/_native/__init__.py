@@ -81,20 +81,45 @@ def _build(so: str) -> bool:
             pass
 
 
+# Every symbol the current protocol needs.  A cached .so missing any of
+# these is a stale build: mixing (say) a native atomic wait_seq with a
+# Python plain-store store_seq silently reintroduces the data race the
+# atomic pair exists to prevent, so stale builds are rebuilt, never
+# partially patched.
+_REQUIRED = ("copy", "prefault", "wait_seq", "store_seq")
+
+
+def _import_so(so: str):
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "ray_tpu._native._fastpath", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    if any(not hasattr(mod, sym) for sym in _REQUIRED):
+        return None  # stale ABI — caller rebuilds
+    return mod
+
+
 def _load():
     global _ext, available
     so = _so_path()
     with _build_lock:
         if _ext is not None:
             return
-        if not _fresh(so) and not _build(so):
-            return
-        try:
-            spec = importlib.util.spec_from_file_location("ray_tpu._native._fastpath", so)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-        except Exception:
-            return
+        mod = _import_so(so) if _fresh(so) else None
+        if mod is None:
+            # Missing, out of date, or symbol-incomplete: rebuild from
+            # source.  (dlopen caches by path per-process, so the rebuild
+            # helps the NEXT process if this one already dlopened a stale
+            # image — that process stays on the pure-Python fallback, which
+            # is slow but protocol-consistent on both sides of the pair.)
+            if not _build(so):
+                return
+            mod = _import_so(so)
+            if mod is None:
+                return
         _ext = mod
         available = True
 
@@ -105,12 +130,7 @@ if available:
     copy = _ext.copy
     prefault = _ext.prefault
     wait_seq = _ext.wait_seq
-    store_seq = getattr(_ext, "store_seq", None)
-    if store_seq is None:  # stale cached .so without the symbol
-        def store_seq(buf, offset: int, value: int) -> None:  # type: ignore[misc]
-            import struct
-
-            struct.pack_into("<Q", buf, offset, value)
+    store_seq = _ext.store_seq
 else:
     def copy(dest, src, nthreads: int = 0) -> int:  # type: ignore[misc]
         m = memoryview(src)

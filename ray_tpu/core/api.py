@@ -837,10 +837,12 @@ class ActorClass:
             creation_task["concurrency_groups"] = dict(groups)
             creation_task["method_groups"] = method_groups
         if o.get("execute_out_of_order"):
-            # Opt-in unordered execution: tasks dispatch to threads as they
-            # arrive, so completion (and effect) order may differ from
-            # submission order (reference:
-            # out_of_order_actor_submit_queue.h).
+            # Opt-in unordered DISPATCH: dependency-ready tasks may run
+            # before earlier-submitted tasks still waiting on arguments, so
+            # completion (and effect) order may differ from submission
+            # order.  Execution concurrency is still bounded by
+            # max_concurrency (reference: out_of_order_actor_submit_queue.h
+            # reorders the submit queue without widening the pool).
             creation_task["execute_out_of_order"] = True
         spec = {
             "actor_id": actor_id.binary(),

@@ -2185,13 +2185,27 @@ class Head:
         await self._push_actor_task(actor, task)
         return {}
 
-    async def _push_actor_task(self, actor: ActorRecord, task: TaskRecord):
+    async def _push_actor_task(
+        self, actor: ActorRecord, task: TaskRecord
+    ) -> bool:
+        """Dispatch one task to the actor's worker.  Returns False when the
+        task could not be dispatched now: re-queued (worker gone, actor
+        restarting) or terminally failed (actor DEAD) — callers draining a
+        queue must stop on False instead of spinning."""
         if task.state != PENDING:  # e.g. cancelled while queued
-            return
+            return True
+        if actor.state == "DEAD":
+            # The death handler already failed whatever was queued at the
+            # time; a task resurfacing later (e.g. a drain snapshot that
+            # raced the death) must fail the same way, never be orphaned on
+            # a queue nothing will drain again.
+            actor.pending_tasks.append(task)
+            await self._fail_actor_queue(actor, None)
+            return False
         worker = self.workers.get(actor.worker_id)
         if worker is None or not worker.conn.alive:
             actor.pending_tasks.append(task)
-            return
+            return False
         task.state = RUNNING
         task.worker_id = worker.worker_id
         task.node_id = worker.node_id
@@ -2199,8 +2213,35 @@ class Head:
         task.start_time = time.time()
         worker.inflight.add(task.task_id)
         await worker.conn.push("execute_task", task.spec)
+        return True
 
     async def _drain_actor_queue(self, actor: ActorRecord):
+        if (actor.spec.get("creation_task") or {}).get(
+                "execute_out_of_order"):
+            # Out-of-order submit queue: dependency-READY tasks dispatch
+            # past dep-blocked ones; relative order among ready tasks is
+            # preserved (reference: out_of_order_actor_submit_queue.h —
+            # dispatch reordering only; the worker still bounds execution
+            # concurrency by max_concurrency).
+            ready = [t for t in actor.pending_tasks
+                     if t.state == PENDING and not t.pending_deps]
+            # Replace the queue BEFORE awaiting: _push_actor_task may
+            # re-append (dead worker), and new submissions may land
+            # mid-await — both must go to the live deque, not a snapshot.
+            actor.pending_tasks = deque(
+                t for t in actor.pending_tasks
+                if t.state == PENDING and t.pending_deps)
+            for i, task in enumerate(ready):
+                if not await self._push_actor_task(actor, task):
+                    # Worker vanished mid-drain: requeue the untried rest
+                    # (the failed one was already re-appended or failed).
+                    actor.pending_tasks.extend(ready[i + 1:])
+                    if actor.state == "DEAD":
+                        # The death handler's queue-fail already ran; these
+                        # stragglers must fail too, not sit orphaned.
+                        await self._fail_actor_queue(actor, None)
+                    return
+            return
         while actor.pending_tasks:
             task = actor.pending_tasks[0]
             if task.state != PENDING:  # cancelled: drop and move on
@@ -2209,7 +2250,13 @@ class Head:
             if task.pending_deps:
                 break  # FIFO order: a dep-blocked head blocks the queue
             actor.pending_tasks.popleft()
-            await self._push_actor_task(actor, task)
+            if not await self._push_actor_task(actor, task):
+                # Not dispatchable now (worker died / actor DEAD): the task
+                # is back on the queue or failed.  Stop — looping again
+                # would pop and re-append the same head in a tight,
+                # never-yielding spin that starves the event loop (incl.
+                # the death handler that would break the cycle).
+                break
 
     async def _fail_actor_queue(self, actor: ActorRecord, error: Optional[bytes]):
         err = error or serialization.pack(
@@ -2277,7 +2324,8 @@ class Head:
             "actor_id": actor_id.binary(),
             "spec": {
                 k: actor.spec.get(k)
-                for k in ("class_name", "method_names", "max_task_retries")
+                for k in ("class_name", "method_names", "max_task_retries",
+                          "method_defaults")
             },
         }
 

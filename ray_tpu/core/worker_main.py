@@ -495,9 +495,13 @@ class Worker:
                     # With groups (or unordered execution) the default
                     # lane must also be pool-dispatched — inline execution
                     # would block the dispatch loop and stall every group.
+                    # The pool never exceeds max_concurrency: out-of-order
+                    # actors get reordered DISPATCH (head-side, see
+                    # Head._drain_actor_queue), not extra execution threads,
+                    # so unsynchronized actor state cannot race beyond what
+                    # the user opted into.
                     self.pool = ThreadPoolExecutor(
-                        max(self.max_concurrency,
-                            8 if self.out_of_order else 1),
+                        max(self.max_concurrency, 1),
                         thread_name_prefix="cg-default")
                 self._report_done(
                     spec,

@@ -33,6 +33,7 @@ Graph surface (single-node; all channel endpoints share /dev/shm):
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -274,31 +275,53 @@ class CompiledDAG:
             return fut
 
     def _resolve_until(self, fut: DagFuture, timeout: float):
-        with self._drain_lock:
-            while not fut._done:
-                if self._broken or self._torn_down:
-                    # Poisoned/closed: channels may be desynchronized or
-                    # unlinked — fail pending futures instead of draining
-                    # mispaired (or freed) values.
-                    why = ("DAG was torn down" if self._torn_down
-                           else f"DAG is desynchronized ({self._broken})")
-                    while self._pending:
-                        h = self._pending.popleft()
-                        if not h._done:
-                            h._value = RuntimeError(why)
-                            h._done = True
-                    if not fut._done:
-                        fut._value = RuntimeError(why)
-                        fut._done = True
-                    break
-                if not self._pending:
-                    raise RuntimeError("future already resolved")
-                head = self._pending.popleft()
-                try:
-                    head._value = self._read_outputs(timeout)
-                except BaseException as e:  # noqa: BLE001
-                    head._value = e
-                head._done = True
+        # Bound the lock acquisition by the caller's timeout too: another
+        # thread may hold _drain_lock blocked inside a channel read, and a
+        # result(timeout) must not wait past its deadline for the lock
+        # (it re-checks _done first — the holder may have resolved us).
+        # Lock-wait time counts against the same deadline as the drain.
+        deadline = (time.monotonic() + timeout) if timeout >= 0 else None
+        if not self._drain_lock.acquire(
+                timeout=timeout if timeout >= 0 else -1):
+            if fut._done:
+                if isinstance(fut._value, BaseException):
+                    raise fut._value
+                return fut._value
+            raise TimeoutError(
+                f"result not available within {timeout}s "
+                "(another thread is draining the DAG)")
+        try:
+            remaining = (max(deadline - time.monotonic(), 0.0)
+                         if deadline is not None else timeout)
+            return self._resolve_locked(fut, remaining)
+        finally:
+            self._drain_lock.release()
+
+    def _resolve_locked(self, fut: DagFuture, timeout: float):
+        while not fut._done:
+            if self._broken or self._torn_down:
+                # Poisoned/closed: channels may be desynchronized or
+                # unlinked — fail pending futures instead of draining
+                # mispaired (or freed) values.
+                why = ("DAG was torn down" if self._torn_down
+                       else f"DAG is desynchronized ({self._broken})")
+                while self._pending:
+                    h = self._pending.popleft()
+                    if not h._done:
+                        h._value = RuntimeError(why)
+                        h._done = True
+                if not fut._done:
+                    fut._value = RuntimeError(why)
+                    fut._done = True
+                break
+            if not self._pending:
+                raise RuntimeError("future already resolved")
+            head = self._pending.popleft()
+            try:
+                head._value = self._read_outputs(timeout)
+            except BaseException as e:  # noqa: BLE001
+                head._value = e
+            head._done = True
         if isinstance(fut._value, BaseException):
             raise fut._value
         return fut._value

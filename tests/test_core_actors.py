@@ -295,23 +295,45 @@ def test_concurrency_group_call_time_override(rt):
 
 
 def test_out_of_order_actor_execution(rt):
-    """execute_out_of_order=True: completion order follows readiness, not
-    submission order (reference: out_of_order_actor_submit_queue.h)."""
+    """execute_out_of_order=True reorders DISPATCH by dependency readiness
+    — a task blocked on a not-yet-ready argument does not stall later
+    dependency-ready tasks — while execution concurrency stays bounded by
+    max_concurrency (reference: out_of_order_actor_submit_queue.h reorders
+    the submit queue; it does not widen the execution pool)."""
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(4.0)
+        return 100
 
     @ray_tpu.remote(execute_out_of_order=True)
     class Unordered:
-        def slow_then_fast(self, i, delay):
-            time.sleep(delay)
-            return i
+        def __init__(self):
+            self.running = 0
+            self.peak = 0
+
+        def use(self, v):
+            self.running += 1
+            self.peak = max(self.peak, self.running)
+            time.sleep(0.05)
+            self.running -= 1
+            return v
+
+        def peak_concurrency(self):
+            return self.peak
 
     a = Unordered.remote()
-    first = a.slow_then_fast.remote(0, 4.0)   # submitted first, slow
-    second = a.slow_then_fast.remote(1, 0.0)  # submitted second, instant
+    dep = slow_value.remote()
+    first = a.use.remote(dep)  # submitted first, argument not ready for ~4s
+    second = a.use.remote(1)   # submitted second, ready immediately
     ready, _ = ray_tpu.wait([first, second], num_returns=1, timeout=3.0)
-    # The later-submitted task must finish first.
+    # The later-submitted (dependency-ready) task must finish first.
     assert len(ready) == 1
     assert ray_tpu.get(ready[0]) == 1
-    assert ray_tpu.get([first, second], timeout=20) == [0, 1]
+    assert ray_tpu.get([first, second], timeout=20) == [100, 1]
+    # Reordering must not imply concurrency: max_concurrency defaults to 1,
+    # so method bodies never overlapped.
+    assert ray_tpu.get(a.peak_concurrency.remote(), timeout=10) == 1
 
 
 def test_ordered_actor_stays_fifo(rt):
@@ -392,3 +414,23 @@ def test_method_annotation_num_returns_and_orphan_group(rt):
 
     with pytest.raises(ValueError, match="concurrency group"):
         Orphan.remote()
+
+
+def test_get_actor_by_name_preserves_method_defaults(rt):
+    """An ActorHandle recovered via get_actor(name) must keep
+    @ray_tpu.method annotations — the reply used to drop method_defaults,
+    so pair.remote() on the looked-up handle returned ONE ref while the
+    worker produced two returns."""
+
+    @ray_tpu.remote
+    class NamedSplitter:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 3, 4
+
+    NamedSplitter.options(name="named-splitter").remote()
+    h = ray_tpu.get_actor("named-splitter")
+    r1, r2 = h.pair.remote()
+    assert ray_tpu.get([r1, r2], timeout=10) == [3, 4]
+
+
