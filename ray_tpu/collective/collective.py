@@ -231,45 +231,80 @@ def _wait_key(key: str, timeout: float) -> Any:
         time.sleep(_POLL_S)
 
 
-def _gather_all(g: GroupState, tag: str, value, timeout: float) -> List[Any]:
+def _tree_children(rank: int, world: int) -> List[int]:
+    return [c for c in (2 * rank + 1, 2 * rank + 2) if c < world]
+
+
+def _tree_parent(rank: int) -> int:
+    return (rank - 1) // 2
+
+
+def _tree_exchange(g: GroupState, tag: str, value, combine, timeout: float):
+    """Binary-tree reduce to rank 0, then a tree broadcast of the result.
+
+    Each rank performs O(1) KV puts (its reduce contribution up + its relay
+    down) and waits on O(1) keys (<=2 children + 1 parent), so a whole
+    collective costs O(world) KV operations at O(log world) depth — vs the
+    flat _gather_all pattern where every rank reads every other rank's key
+    (O(world^2) reads).  `combine` must be associative; combine order is
+    deterministic per tree shape, so every rank computes bit-identical
+    results for fp payloads.
+    """
     seq = g.next_seq(tag)
     base = f"{g.ns}:{tag}:{seq}"
-    _post(f"{base}:{g.rank}", value)
-    out = [
-        _wait_key(f"{base}:{r}", timeout) if r != g.rank else value
-        for r in range(g.world_size)
-    ]
-    # Lazy cleanup: delete our rank's key from two ops ago (everyone has
-    # certainly consumed it — op N+1 acted as a barrier).
+    acc = value
+    for c in _tree_children(g.rank, g.world_size):
+        acc = combine(acc, _wait_key(f"{base}:up:{c}", timeout))
+    if g.rank == 0:
+        result = acc
+        if g.world_size > 1:
+            _post(f"{base}:dn:0", result)
+    else:
+        _post(f"{base}:up:{g.rank}", acc)
+        result = _wait_key(f"{base}:dn:{_tree_parent(g.rank)}", timeout)
+        if _tree_children(g.rank, g.world_size):
+            _post(f"{base}:dn:{g.rank}", result)
+    # Lazy cleanup of the keys THIS rank posted two ops ago (op N+1's
+    # up/down waves guarantee every consumer has read them).
     if seq > 2:
-        _client().kv_del(f"{g.ns}:{tag}:{seq - 2}:{g.rank}")
-    return out
+        c = _client()
+        old = f"{g.ns}:{tag}:{seq - 2}"
+        if g.rank != 0:
+            c.kv_del(f"{old}:up:{g.rank}")
+        if g.rank == 0 or _tree_children(g.rank, g.world_size):
+            c.kv_del(f"{old}:dn:{g.rank}")
+    return result
 
 
 # --------------------------------------------------------------------- ops
 
 
+_COMBINE = {"sum": np.add, "mean": np.add,
+            "max": np.maximum, "min": np.minimum}
+
+
 def allreduce(tensor: np.ndarray, *, group_name: str = "default",
               op: str = "sum", timeout: float = 60.0) -> np.ndarray:
+    combine = _COMBINE.get(op)
+    if combine is None:
+        raise ValueError(f"unsupported op {op!r}")
     g = _group(group_name)
-    parts = _gather_all(g, "ar", np.asarray(tensor), timeout)
-    stack = np.stack(parts)
-    if op == "sum":
-        return stack.sum(axis=0)
+    out = np.asarray(
+        _tree_exchange(g, "ar", np.asarray(tensor), combine, timeout)
+    )
     if op == "mean":
-        return stack.mean(axis=0)
-    if op == "max":
-        return stack.max(axis=0)
-    if op == "min":
-        return stack.min(axis=0)
-    raise ValueError(f"unsupported op {op!r}")
+        out = out / g.world_size
+    return out
 
 
 def allgather(tensor: np.ndarray, *, group_name: str = "default",
               timeout: float = 60.0) -> List[np.ndarray]:
     g = _group(group_name)
-    return [np.asarray(t) for t in
-            _gather_all(g, "ag", np.asarray(tensor), timeout)]
+    merged = _tree_exchange(
+        g, "ag", {g.rank: np.asarray(tensor)},
+        lambda a, b: {**a, **b}, timeout,
+    )
+    return [np.asarray(merged[r]) for r in range(g.world_size)]
 
 
 def reducescatter(tensor: np.ndarray, *, group_name: str = "default",
@@ -295,7 +330,7 @@ def broadcast(tensor: Optional[np.ndarray], *, group_name: str = "default",
 
 def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
     g = _group(group_name)
-    _gather_all(g, "bar", g.rank, timeout)
+    _tree_exchange(g, "bar", None, lambda a, b: None, timeout)
 
 
 def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",

@@ -2204,7 +2204,10 @@ class Head:
             return False
         worker = self.workers.get(actor.worker_id)
         if worker is None or not worker.conn.alive:
-            actor.pending_tasks.append(task)
+            # Back to the FRONT: the FIFO drain popped this task from the
+            # head of the queue, and a tail re-append would reorder it
+            # behind later submissions across a restart.
+            actor.pending_tasks.appendleft(task)
             return False
         task.state = RUNNING
         task.worker_id = worker.worker_id

@@ -293,6 +293,13 @@ class CompiledDAG:
         try:
             remaining = (max(deadline - time.monotonic(), 0.0)
                          if deadline is not None else timeout)
+            if remaining <= 0.0 and not fut._done:
+                # The lock wait consumed the whole budget.  Raise to THIS
+                # caller without starting a drain: a zero-budget channel
+                # read would time out and poison the (healthy) DAG.
+                raise TimeoutError(
+                    f"result not available within {timeout}s "
+                    "(deadline spent waiting for the drain lock)")
             return self._resolve_locked(fut, remaining)
         finally:
             self._drain_lock.release()
