@@ -342,6 +342,29 @@ def bench_rllib(quick: bool):
     finally:
         algo.stop()
 
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    algo = (MultiAgentPPOConfig()
+            .environment("MultiAgentCartPole", num_agents=4)
+            .multi_agent(
+                policies=["shared"],
+                policy_mapping_fn=lambda a: "shared",
+            )
+            .env_runners(num_env_runners=2, rollout_fragment_length=256)
+            .build())
+    try:
+        algo.train()  # compile + warmup
+        rates = []
+        for _ in range(3 if quick else 10):
+            r = algo.train()
+            rates.append(r["env_steps_per_sec"])
+            print(f"# multi-agent ppo iter: "
+                  f"sps={r['env_steps_per_sec']:.0f}", file=sys.stderr)
+        record("multi_agent_env_steps_per_sec",
+               float(np.median(rates)), "steps/s")
+    finally:
+        algo.stop()
+
 
 def main():
     ap = argparse.ArgumentParser()

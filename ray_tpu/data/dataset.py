@@ -26,11 +26,93 @@ import ray_tpu
 
 from .block import Batch, Block
 from .context import DataContext
+from .logical import LogicalOp, LogicalPlan
 
 # A part is one block's production recipe: a source (callable returning a
 # Block, or an ObjectRef of a materialized Block) plus the op chain to apply.
 Source = Any
 Op = Callable[[Block], Block]
+
+
+class _TimedOp:
+    """A named per-block op.  The name feeds Dataset.stats()' per-operator
+    rows/wall breakdown (reference: each physical operator carries
+    OpRuntimeMetrics — _internal/execution/interfaces/op_runtime_metrics.py).
+    Execution cost is one attribute lookup; timing only happens on the
+    stats path."""
+
+    __slots__ = ("name", "fn")
+
+    def __init__(self, name: str, fn: Op):
+        self.name = name
+        self.fn = fn
+
+    def __call__(self, block: Block) -> Block:
+        return self.fn(block)
+
+
+def _op_name(op: Op) -> str:
+    if isinstance(op, _TimedOp):
+        return op.name
+    if isinstance(op, _StatefulBatchOp):
+        return f"MapBatches({op.fn_cls.__name__})"
+    return getattr(op, "__name__", type(op).__name__)
+
+
+class _ReadTask:
+    """Picklable file-read source with pushdown knobs (reference:
+    datasource ReadTask + the logical Read op that column/limit pushdown
+    rules rewrite — logical/rules/).  ``columns`` prunes at the parquet
+    reader (only those columns are decoded); ``limit`` caps rows per part.
+    """
+
+    __slots__ = ("kind", "files", "columns", "limit", "reader_kwargs")
+
+    SUPPORTS_COLUMNS = {"parquet"}
+
+    def __init__(self, kind: str, files: List[str],
+                 columns: Optional[List[str]] = None,
+                 limit: Optional[int] = None, reader_kwargs=None):
+        self.kind = kind
+        self.files = files
+        self.columns = columns
+        self.limit = limit
+        self.reader_kwargs = reader_kwargs or {}
+
+    def _read_one(self, f: str) -> Block:
+        if self.kind == "parquet":
+            import pyarrow.parquet as pq
+
+            return Block.from_arrow(pq.read_table(f, columns=self.columns))
+        if self.kind == "csv":
+            import pyarrow.csv as pacsv
+
+            return Block.from_arrow(pacsv.read_csv(f))
+        if self.kind == "json":
+            import pyarrow.json as pajson
+
+            return Block.from_arrow(pajson.read_json(f))
+        if self.kind == "images":
+            return _read_image_file(f, **self.reader_kwargs)
+        raise ValueError(f"unknown read kind {self.kind!r}")
+
+    def __call__(self) -> Block:
+        blocks: List[Block] = []
+        rows = 0
+        for f in self.files:
+            b = self._read_one(f)
+            blocks.append(b)
+            rows += b.num_rows
+            if self.limit is not None and rows >= self.limit:
+                break  # row-limited read: later files are never opened
+        out = Block.concat(blocks)
+        if self.limit is not None and out.num_rows > self.limit:
+            out = out.slice(0, self.limit)
+        return out
+
+    @property
+    def name(self) -> str:
+        return f"Read{self.kind.capitalize()}"
 
 
 def _exec_part_body(source: Source, ops: List[Op]) -> Block:
@@ -43,6 +125,28 @@ def _exec_part_body(source: Source, ops: List[Op]) -> Block:
 @ray_tpu.remote
 def _exec_part(source: Source, ops: List[Op]) -> Block:
     return _exec_part_body(source, ops)
+
+
+@ray_tpu.remote
+def _exec_part_profiled(source: Source, ops: List[Op]) -> List[tuple]:
+    """Run the chain timing each operator; returns
+    [(op_name, wall_s, rows_out), ...] including the source read.  This is
+    the Dataset.stats() backend (reference: op runtime metrics are sampled
+    during normal execution; here profiling is an explicit pass so the hot
+    path stays timer-free)."""
+    import time as _time
+
+    out: List[tuple] = []
+    t0 = _time.perf_counter()
+    block = source() if callable(source) else source
+    name = getattr(source, "name", "Source")
+    out.append((name, _time.perf_counter() - t0, block.num_rows))
+    for op in ops:
+        t0 = _time.perf_counter()
+        block = op(block)
+        out.append((_op_name(op), _time.perf_counter() - t0,
+                    block.num_rows))
+    return out
 
 
 @ray_tpu.remote
@@ -527,18 +631,25 @@ class Dataset:
 
     def __init__(self, parts: List[tuple],
                  counts: Optional[List[int]] = None,
-                 total_rows: Optional[int] = None):
+                 total_rows: Optional[int] = None,
+                 logical=None):
         self._parts = parts  # [(source, [op, ...]), ...]
         self._counts = counts  # per-part row counts, when known
         # Total row count when per-part counts are unknown but the total is
         # invariant (sort/shuffle exchanges preserve it).
         self._total_rows = (sum(counts) if counts is not None
                             else total_rows)
+        # The inspectable plan description (reference: logical_plan.py);
+        # optimize() fires fusion/pushdown rules over it (logical.py).
+        self._logical = logical if logical is not None else LogicalPlan()
 
     # ---------------------------------------------------------- transforms
 
-    def _with_op(self, op: Op) -> "Dataset":
-        return Dataset([(src, ops + [op]) for src, ops in self._parts])
+    def _with_op(self, op: Op, lop=None) -> "Dataset":
+        if lop is None:
+            lop = LogicalOp("map", _op_name(op))
+        return Dataset([(src, ops + [op]) for src, ops in self._parts],
+                       logical=self._logical.appended(lop))
 
     def _plan_parts(self) -> List[tuple]:
         """Parts safe for direct stateless-task submission.  A chain with an
@@ -574,20 +685,30 @@ class Dataset:
                     "(constructed once per pool actor); got "
                     f"{type(fn).__name__}"
                 )
-            return self._with_op(_StatefulBatchOp(
+            sop = _StatefulBatchOp(
                 fn, fn_constructor_args, fn_constructor_kwargs,
                 batch_format, fn_kwargs, compute,
-            ))
+            )
+            return self._with_op(sop, LogicalOp(
+                "map_batches", _op_name(sop),
+                {"compute": f"ActorPool({compute.size})"}))
         if isinstance(fn, type):
             # Task path: one driver-side instance shipped to tasks.
+            fname = fn.__name__
             fn = fn(*fn_constructor_args, **(fn_constructor_kwargs or {}))
-        return self._with_op(_batch_op(fn, batch_format, fn_kwargs))
+        else:
+            fname = getattr(fn, "__name__", type(fn).__name__)
+        return self._with_op(
+            _TimedOp(f"MapBatches({fname})",
+                     _batch_op(fn, batch_format, fn_kwargs)),
+            LogicalOp("map_batches", f"MapBatches({fname})"))
 
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
         def op(block: Block) -> Block:
             return Block.from_items([fn(row) for row in block.rows()])
 
-        return self._with_op(op)
+        name = f"Map({getattr(fn, '__name__', 'fn')})"
+        return self._with_op(_TimedOp(name, op), LogicalOp("map", name))
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
         def op(block: Block) -> Block:
@@ -596,7 +717,9 @@ class Dataset:
                 rows.extend(fn(row))
             return Block.from_items(rows) if rows else Block({})
 
-        return self._with_op(op)
+        name = f"FlatMap({getattr(fn, '__name__', 'fn')})"
+        return self._with_op(_TimedOp(name, op),
+                             LogicalOp("flat_map", name))
 
     def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
         def op(block: Block) -> Block:
@@ -607,10 +730,46 @@ class Dataset:
             )
             return Block({k: v[keep] for k, v in batch.items()})
 
-        return self._with_op(op)
+        name = f"Filter({getattr(fn, '__name__', 'fn')})"
+        return self._with_op(_TimedOp(name, op), LogicalOp("filter", name))
+
+    def _try_read_pushdown(self, **updates) -> Optional["Dataset"]:
+        """Fold a projection/limit into the read sources when every part is
+        a bare pushdown-capable _ReadTask (reference: the logical rules in
+        logical/rules/ rewrite Read ops the same way).  Returns the new
+        parts list, or None when pushdown does not apply."""
+        if not self._parts:
+            return None
+        for src, ops in self._parts:
+            if ops or not isinstance(src, _ReadTask):
+                return None
+            if ("columns" in updates
+                    and src.kind not in _ReadTask.SUPPORTS_COLUMNS):
+                return None
+            if "columns" in updates and src.columns is not None:
+                return None  # already pruned: chain the op instead
+        new_parts = []
+        for src, _ in self._parts:
+            ns = _ReadTask(src.kind, src.files,
+                           updates.get("columns", src.columns),
+                           updates.get("limit", src.limit),
+                           src.reader_kwargs)
+            new_parts.append((ns, []))
+        return new_parts
 
     def select_columns(self, columns: Sequence[str]) -> "Dataset":
-        return self._with_op(lambda b: b.select(columns))
+        cols = list(columns)
+        pushed = self._try_read_pushdown(columns=cols)
+        if pushed is not None:
+            # Column pruning folds into the parquet read itself: pruned
+            # columns are never decoded, and the logical plan records the
+            # rewritten Read (the optimizer's ReadPushdown rule output).
+            lop = LogicalOp("project", "Project", {"columns": cols})
+            return Dataset(pushed, self._counts, self._total_rows,
+                           logical=self._logical.appended(lop))
+        return self._with_op(
+            _TimedOp("Project", lambda b: b.select(cols)),
+            LogicalOp("project", "Project", {"columns": cols}))
 
     def add_column(self, name: str, fn: Callable[[Batch], np.ndarray]) -> "Dataset":
         def op(block: Block) -> Block:
@@ -618,7 +777,8 @@ class Dataset:
             batch[name] = np.asarray(fn(batch))
             return Block.from_batch(batch)
 
-        return self._with_op(op)
+        return self._with_op(_TimedOp(f"AddColumn({name})", op),
+                             LogicalOp("add_column", f"AddColumn({name})"))
 
     def drop_columns(self, columns: Sequence[str]) -> "Dataset":
         drop = set(columns)
@@ -626,7 +786,10 @@ class Dataset:
         def op(block: Block) -> Block:
             return block.select([c for c in block.columns() if c not in drop])
 
-        return self._with_op(op)
+        return self._with_op(
+            _TimedOp("DropColumns", op),
+            LogicalOp("drop_column", "DropColumns",
+                      {"columns": sorted(drop)}))
 
     # ------------------------------------------------------- reorganization
 
@@ -839,11 +1002,22 @@ class Dataset:
         return [train, Dataset(tail_parts, tail_counts)]
 
     def limit(self, k: int) -> "Dataset":
-        """First k rows (streams only as many parts as needed)."""
+        """First k rows (streams only as many parts as needed).  On a bare
+        file-read plan the limit pushes into the read itself first (each
+        part stops opening files once it has k rows), so a limit over a
+        large dataset never materializes whole blocks."""
+        lop = LogicalOp("limit", "Limit", {"n": k})
+        src_ds = self
+        pushed = self._try_read_pushdown(limit=k)
+        if pushed is not None:
+            src_ds = Dataset(pushed, logical=self._logical.appended(lop))
+        else:
+            src_ds = Dataset(self._parts, self._counts, self._total_rows,
+                             logical=self._logical.appended(lop))
         taken: List[tuple] = []
         counts: List[int] = []
         remaining = k
-        for ref in self._iter_block_refs():
+        for ref in src_ds._iter_block_refs():
             if remaining <= 0:
                 break
             block = ray_tpu.get(ref)
@@ -856,7 +1030,7 @@ class Dataset:
                 taken.append((ray_tpu.put(block.slice(0, remaining)), []))
                 counts.append(remaining)
                 remaining = 0
-        return Dataset(taken, counts)
+        return Dataset(taken, counts, logical=src_ds._logical)
 
     # ------------------------------------------------------------ execution
 
@@ -940,7 +1114,63 @@ class Dataset:
         """Execute the plan; the result holds materialized block refs
         (reference: dataset.py materialize:4622)."""
         refs, counts = self._materialize_refs()
-        return Dataset([(r, []) for r in refs], counts)
+        return Dataset([(r, []) for r in refs], counts,
+                       logical=self._logical)
+
+    # --------------------------------------------------------- plan insight
+
+    def explain(self) -> str:
+        """The logical plan, its optimized form, and the rules that fired
+        (reference: logical/optimizers.py — LogicalOptimizer rule list)."""
+        optimized, fired = self._logical.optimize()
+        lines = ["-- logical plan --", self._logical.describe(),
+                 "-- optimized (physical stages) --", optimized.describe()]
+        if fired:
+            lines += ["-- rules fired --"] + [f"  {r}" for r in fired]
+        lines.append(f"-- execution: {len(self._parts)} block(s), "
+                     "fused chain = one task per block --")
+        return "\n".join(lines)
+
+    def stats(self) -> Dict[str, Any]:
+        """Per-operator rows/wall breakdown from a profiled execution of
+        the plan, plus the optimized stage list (reference: dataset.py
+        stats:4790 returns per-operator wall/rows/output sizes).  Profiling
+        runs the chain once with timers; the normal execution path carries
+        no timing overhead."""
+        per_part = ray_tpu.get([
+            _exec_part_profiled.remote(src, ops)
+            for src, ops in self._plan_parts()
+        ])
+        operators: List[Dict[str, Any]] = []
+        agg: Dict[str, Dict[str, Any]] = {}
+        for rows in per_part:
+            for name, wall, n_rows in rows:
+                ent = agg.get(name)
+                if ent is None:
+                    ent = agg[name] = {
+                        "operator": name, "tasks": 0, "rows_out": 0,
+                        "wall_total_s": 0.0,
+                    }
+                    operators.append(ent)
+                ent["tasks"] += 1
+                ent["rows_out"] += int(n_rows)
+                ent["wall_total_s"] += float(wall)
+        for ent in operators:
+            ent["wall_total_s"] = round(ent["wall_total_s"], 6)
+            ent["wall_mean_s"] = round(
+                ent["wall_total_s"] / max(ent["tasks"], 1), 6)
+        optimized, fired = self._logical.optimize()
+        return {
+            "operators": operators,
+            "num_blocks": len(self._parts),
+            # Map chains execute inside ONE task per block — the physical
+            # realization of the fusion rule.
+            "tasks_per_block": 1,
+            "optimized_stages": [op.describe() for op in optimized.ops],
+            "rules_fired": fired,
+            "last_execution": dict(
+                DataContext.get_current().last_execution_stats),
+        }
 
     # ---------------------------------------------------------- consumption
 
@@ -1268,55 +1498,63 @@ def _expand_paths(paths: Union[str, Sequence[str]], suffixes) -> List[str]:
     return out
 
 
-def _read_source(files: List[str], reader: Callable[[str], Block],
-                 override_num_blocks: Optional[int]) -> Dataset:
+def _read_image_file(f: str, *, size: Optional[tuple] = None,
+                     mode: str = "RGB",
+                     include_paths: bool = False) -> Block:
+    from PIL import Image
+
+    with Image.open(f) as im:
+        im = im.convert(mode)
+        if size is not None:
+            im = im.resize((size[1], size[0]))  # PIL takes (w, h)
+        arr = np.asarray(im, dtype=np.uint8)
+    cols = {"image": arr[None]}
+    if include_paths:
+        cols["path"] = np.array([f], dtype=object)
+    return Block.from_batch(cols)
+
+
+def _read_source(kind: str, files: List[str],
+                 override_num_blocks: Optional[int],
+                 columns: Optional[List[str]] = None,
+                 reader_kwargs=None) -> Dataset:
     """One read task per file (reference: read_api.py splits files across
-    read tasks; per-file granularity is the common case)."""
-
-    def read_many(fs: List[str]) -> Block:
-        return Block.concat([reader(f) for f in fs])
-
+    read tasks; per-file granularity is the common case).  Sources are
+    _ReadTask objects so projection/limit pushdown can rewrite them."""
     n = override_num_blocks or len(files)
     n = min(n, len(files))
     parts = []
     for i in builtins.range(n):
         chunk = files[len(files) * i // n: len(files) * (i + 1) // n]
         if chunk:
-            parts.append((functools.partial(read_many, chunk), []))
-    return Dataset(parts)
+            parts.append((_ReadTask(kind, chunk, columns,
+                                    reader_kwargs=reader_kwargs), []))
+    lop = LogicalOp("read", f"Read{kind.capitalize()}", {
+        "files": len(files), "columns": columns,
+        "supports_columns": kind in _ReadTask.SUPPORTS_COLUMNS,
+        "supports_limit": True,
+    })
+    return Dataset(parts, logical=LogicalPlan([lop]))
 
 
 def read_parquet(paths, *, override_num_blocks: Optional[int] = None,
                  columns: Optional[List[str]] = None) -> Dataset:
-    def reader(f: str) -> Block:
-        import pyarrow.parquet as pq
-
-        return Block.from_arrow(pq.read_table(f, columns=columns))
-
     return _read_source(
-        _expand_paths(paths, (".parquet",)), reader, override_num_blocks
+        "parquet", _expand_paths(paths, (".parquet",)),
+        override_num_blocks, columns,
     )
 
 
 def read_csv(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
-    def reader(f: str) -> Block:
-        import pyarrow.csv as pacsv
-
-        return Block.from_arrow(pacsv.read_csv(f))
-
     return _read_source(
-        _expand_paths(paths, (".csv",)), reader, override_num_blocks
+        "csv", _expand_paths(paths, (".csv",)), override_num_blocks
     )
 
 
 def read_json(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
-    def reader(f: str) -> Block:
-        import pyarrow.json as pajson
-
-        return Block.from_arrow(pajson.read_json(f))
-
     return _read_source(
-        _expand_paths(paths, (".json", ".jsonl")), reader, override_num_blocks
+        "json", _expand_paths(paths, (".json", ".jsonl")),
+        override_num_blocks
     )
 
 
@@ -1327,20 +1565,10 @@ def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
     (reference: data/read_api.py read_images / datasource ImageDatasource).
     ``size=(h, w)`` resizes so the column has a uniform tensor shape —
     required when source images vary (the batch format is dense numpy)."""
-    def reader(f: str) -> Block:
-        from PIL import Image
-
-        with Image.open(f) as im:
-            im = im.convert(mode)
-            if size is not None:
-                im = im.resize((size[1], size[0]))  # PIL takes (w, h)
-            arr = np.asarray(im, dtype=np.uint8)
-        cols = {"image": arr[None]}
-        if include_paths:
-            cols["path"] = np.array([f], dtype=object)
-        return Block.from_batch(cols)
-
     return _read_source(
+        "images",
         _expand_paths(paths, (".png", ".jpg", ".jpeg", ".bmp", ".gif")),
-        reader, override_num_blocks,
+        override_num_blocks,
+        reader_kwargs={"size": size, "mode": mode,
+                       "include_paths": include_paths},
     )

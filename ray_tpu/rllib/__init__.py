@@ -7,17 +7,33 @@ over a Mesh for multi-chip) and env runners are CPU actors.
 """
 
 from .dqn import DQN, DQNConfig, DQNLearner
-from .env import CartPoleEnv, VectorEnv, make_env, register_env
+from .env import CartPoleEnv, CatchEnv, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
 from .impala import Impala, ImpalaConfig, ImpalaEnvRunner, ImpalaLearner
 from .learner import PPOLearner, compute_gae, init_policy, policy_forward
+from .models import CNNModel, MLPModel, default_model
+from .offline import (
+    BC, JsonReader, JsonWriter, collect_offline_dataset,
+    importance_sampling_estimate,
+)
+from .multi_agent import (
+    MultiAgentCartPole, MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from .ppo import PPO, PPOConfig
 from .replay import ReplayBuffer
+from .sac import SAC, ContinuousEnvRunner, PendulumEnv, SACConfig, SACLearner
 
 __all__ = [
     "PPO", "PPOConfig", "PPOLearner", "EnvRunner",
     "Impala", "ImpalaConfig", "ImpalaEnvRunner", "ImpalaLearner",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
-    "CartPoleEnv", "VectorEnv", "make_env", "register_env",
+    "SAC", "SACConfig", "SACLearner", "ContinuousEnvRunner", "PendulumEnv",
+    "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentEnvRunner",
+    "MultiAgentPPO", "MultiAgentPPOConfig",
+    "CNNModel", "MLPModel", "default_model",
+    "BC", "JsonReader", "JsonWriter", "collect_offline_dataset",
+    "importance_sampling_estimate",
+    "CartPoleEnv", "CatchEnv", "VectorEnv", "make_env", "register_env",
     "compute_gae", "init_policy", "policy_forward",
 ]

@@ -69,7 +69,56 @@ class CartPoleEnv:
         return self.state.copy(), 1.0, terminated, truncated
 
 
-ENV_REGISTRY = {"CartPole-v1": CartPoleEnv}
+class CatchEnv:
+    """Pixel-observation Catch (the bsuite/DeepMind-classic test problem):
+    a ball falls from a random top column; the paddle on the bottom row
+    moves left/stay/right; terminal reward +1 on catch, -1 on miss.
+    Observations are a (rows, cols, 1) float image — exercises the conv
+    policy path (reference: image envs routed to conv nets via
+    models/utils.py get_filter_config; benchmark_atari_ppo.py is the
+    conv-scale benchmark)."""
+
+    ROWS = 10
+    COLS = 5
+    observation_shape = (ROWS, COLS, 1)
+    observation_size = ROWS * COLS
+    num_actions = 3
+    max_episode_steps = ROWS  # ball reaches the bottom in ROWS-1 steps
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.ball_row = 0
+        self.ball_col = 0
+        self.paddle = 0
+        self.steps = 0
+
+    def _obs(self) -> np.ndarray:
+        img = np.zeros(self.observation_shape, np.float32)
+        img[self.ball_row, self.ball_col, 0] = 1.0
+        img[self.ROWS - 1, self.paddle, 0] = 1.0
+        return img
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.ball_row = 0
+        self.ball_col = int(self.rng.integers(0, self.COLS))
+        self.paddle = self.COLS // 2
+        self.steps = 0
+        return self._obs()
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, bool]:
+        self.paddle = int(np.clip(self.paddle + (action - 1), 0,
+                                  self.COLS - 1))
+        self.ball_row += 1
+        self.steps += 1
+        if self.ball_row == self.ROWS - 1:
+            reward = 1.0 if self.paddle == self.ball_col else -1.0
+            return self._obs(), reward, True, False
+        return self._obs(), 0.0, False, False
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPoleEnv, "Catch-v0": CatchEnv}
 
 
 def register_env(name: str, cls) -> None:
@@ -92,6 +141,10 @@ class VectorEnv:
         ]
         self.num_envs = num_envs
         self.observation_size = self.envs[0].observation_size
+        # Image envs expose observation_shape (H, W, C); 1D envs fall back
+        # to (observation_size,).  Everything downstream keys off the shape.
+        self.observation_shape = tuple(getattr(
+            self.envs[0], "observation_shape", (self.observation_size,)))
         self.num_actions = self.envs[0].num_actions
         self.episode_returns = np.zeros(num_envs, np.float64)
         self.completed_returns: List[float] = []

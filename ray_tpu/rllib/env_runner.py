@@ -9,7 +9,7 @@ store each iteration (reference: env_runner_group.sync_weights).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -19,7 +19,7 @@ from .env import VectorEnv
 
 @ray_tpu.remote
 class EnvRunner:
-    def __init__(self, env_spec, num_envs: int, seed: int = 0):
+    def __init__(self, env_spec, num_envs: int, seed: int = 0, model=None):
         import os
 
         # Runner policy inference is tiny; never let XLA grab host threads
@@ -28,6 +28,9 @@ class EnvRunner:
         self.vec = VectorEnv(env_spec, num_envs, seed=seed)
         self.obs = self.vec.reset()
         self.seed = seed
+        # Optional models.* instance (cloudpickled in).  None = the legacy
+        # MLP path where weights arrive as a PolicyParams field list.
+        self.model = model
         self._forward = None
         self._params = None
         self._rng = np.random.default_rng(seed + 1)
@@ -36,17 +39,24 @@ class EnvRunner:
         if self._forward is None:
             import jax
 
-            from .learner import policy_forward
+            if self.model is not None:
+                self._forward = jax.jit(self.model.apply)
+            else:
+                from .learner import policy_forward
 
-            self._forward = jax.jit(policy_forward)
+                self._forward = jax.jit(policy_forward)
         return self._forward
 
     def set_weights(self, weights) -> bool:
+        import jax
+
         import jax.numpy as jnp
 
         from .learner import PolicyParams
 
-        self._params = PolicyParams(*[jnp.asarray(w) for w in weights])
+        if isinstance(weights, list):  # legacy flat field list
+            weights = PolicyParams(*weights)
+        self._params = jax.tree.map(jnp.asarray, weights)
         return True
 
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
@@ -58,7 +68,7 @@ class EnvRunner:
 
         fwd = self._policy()
         N = self.vec.num_envs
-        obs_buf = np.empty((num_steps, N, self.vec.observation_size),
+        obs_buf = np.empty((num_steps, N, *self.vec.observation_shape),
                            np.float32)
         act_buf = np.empty((num_steps, N), np.int32)
         logp_buf = np.empty((num_steps, N), np.float32)
@@ -113,9 +123,10 @@ class EnvRunner:
                                         np.float64),
         }
 
-    def env_info(self) -> Dict[str, int]:
+    def env_info(self) -> Dict[str, Any]:
         return {
             "observation_size": self.vec.observation_size,
+            "observation_shape": self.vec.observation_shape,
             "num_actions": self.vec.num_actions,
         }
 

@@ -125,8 +125,17 @@ class PPOLearner:
         hidden: int = 64,
         seed: int = 0,
         mesh=None,
+        model=None,
     ):
-        self.params = init_policy(obs_size, num_actions, hidden, seed)
+        # Pluggable architecture (reference: rl_module.py — the learner is
+        # model-agnostic).  Default = the classic separate-torso MLP; pass a
+        # models.CNNModel for image observations.
+        if model is None:
+            from .models import MLPModel
+
+            model = MLPModel((obs_size,), num_actions, hidden)
+        self.model = model
+        self.params = model.init(seed)
         self.tx = optax.chain(
             optax.clip_by_global_norm(grad_clip),
             optax.adam(lr, eps=1e-5),
@@ -142,8 +151,10 @@ class PPOLearner:
         clip, vf_c, ent_c = self.clip_param, self.vf_coeff, self.entropy_coeff
         tx = self.tx
 
+        model = self.model
+
         def loss_fn(params, batch):
-            logits, value = policy_forward(params, batch["obs"])
+            logits, value = model.apply(params, batch["obs"])
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None], axis=1

@@ -1,0 +1,133 @@
+"""Policy model catalog: pluggable network architectures for the learners.
+
+Role-equivalent to the reference's RLModule / model catalog layer
+(reference: rllib/core/rl_module/rl_module.py, rllib/models/catalog.py —
+the algorithm is architecture-agnostic; obs space picks the default net,
+conv nets for image observations per models/utils.py get_filter_config).
+
+A model is an object with:
+    init(seed) -> params (a JAX pytree)
+    apply(params, obs) -> (logits, value)
+Learners and env runners only touch this surface, so MLP vs CNN (or a
+custom user model) is a config swap, not a learner change.  TPU notes: the
+CNN keeps channel counts in MXU-friendly multiples and uses NHWC layouts
+(XLA's preferred TPU conv layout); everything jits into one program.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MLPModel:
+    """Separate-torso tanh MLP — the classic-control default (same
+    architecture the PPO/IMPALA learners always used; reference: rllib
+    default fcnet with vf_share_layers=False)."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 hidden: int = 64):
+        self.obs_shape = tuple(obs_shape)
+        self.obs_size = int(np.prod(obs_shape))
+        self.num_actions = num_actions
+        self.hidden = hidden
+
+    def init(self, seed: int = 0):
+        from .learner import init_policy
+
+        return init_policy(self.obs_size, self.num_actions, self.hidden,
+                           seed)
+
+    def apply(self, params, obs):
+        from .learner import policy_forward
+
+        if obs.ndim > 2:
+            obs = obs.reshape(obs.shape[0], -1)
+        return policy_forward(params, obs)
+
+
+class CNNModel:
+    """Conv torso + dense policy/value heads for image observations
+    (reference: rllib models/utils.py get_filter_config — conv stacks are
+    the default for 2D/3D obs; benchmark_atari_ppo.py trains them at scale).
+
+    NHWC activations, HWIO kernels — the layouts XLA maps best onto the TPU
+    MXU's convolution path; channel counts default to multiples of 8 so the
+    contraction dims tile cleanly."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 channels: Sequence[int] = (16, 32),
+                 kernels: Sequence[int] = (3, 3),
+                 strides: Sequence[int] = (1, 1),
+                 dense: int = 128):
+        if len(obs_shape) == 2:
+            obs_shape = (*obs_shape, 1)  # H,W -> H,W,1
+        assert len(obs_shape) == 3, f"CNNModel wants (H, W, C), got {obs_shape}"
+        assert len(channels) == len(kernels) == len(strides)
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        self.channels = tuple(channels)
+        self.kernels = tuple(kernels)
+        self.strides = tuple(strides)
+        self.dense = dense
+
+    def _conv_out_hw(self) -> Tuple[int, int]:
+        h, w, _ = self.obs_shape
+        for k, s in zip(self.kernels, self.strides):
+            h = -(-(h - k + 1) // s)  # VALID conv then ceil-div stride
+            w = -(-(w - k + 1) // s)
+        assert h > 0 and w > 0, "conv stack consumed the whole image"
+        return h, w
+
+    def init(self, seed: int = 0) -> Dict[str, Any]:
+        n_layers = len(self.channels) + 3
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_layers)
+        he = jax.nn.initializers.he_normal()
+        params: Dict[str, Any] = {}
+        c_in = self.obs_shape[-1]
+        for i, (c_out, k) in enumerate(zip(self.channels, self.kernels)):
+            params[f"conv{i}_w"] = he(keys[i], (k, k, c_in, c_out),
+                                      jnp.float32)
+            params[f"conv{i}_b"] = jnp.zeros(c_out)
+            c_in = c_out
+        h, w = self._conv_out_hw()
+        flat = h * w * c_in
+        params["dense_w"] = he(keys[-3], (flat, self.dense), jnp.float32)
+        params["dense_b"] = jnp.zeros(self.dense)
+        params["pi_w"] = jax.nn.initializers.orthogonal(0.01)(
+            keys[-2], (self.dense, self.num_actions), jnp.float32)
+        params["pi_b"] = jnp.zeros(self.num_actions)
+        params["v_w"] = jax.nn.initializers.orthogonal(1.0)(
+            keys[-1], (self.dense, 1), jnp.float32)
+        params["v_b"] = jnp.zeros(1)
+        return params
+
+    def apply(self, params, obs):
+        x = jnp.asarray(obs, jnp.float32)
+        if x.ndim == 3:  # missing channel dim: B,H,W -> B,H,W,1
+            x = x[..., None]
+        for i, s in enumerate(self.strides):
+            x = jax.lax.conv_general_dilated(
+                x, params[f"conv{i}_w"], window_strides=(s, s),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + params[f"conv{i}_b"]
+            x = jax.nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        h = jax.nn.relu(x @ params["dense_w"] + params["dense_b"])
+        logits = h @ params["pi_w"] + params["pi_b"]
+        value = (h @ params["v_w"] + params["v_b"])[..., 0]
+        return logits, value
+
+
+def default_model(obs_shape: Tuple[int, ...], num_actions: int,
+                  hidden: int = 64):
+    """Obs-shape dispatch (reference: catalog.py _get_encoder_config —
+    1D obs -> MLP, 2D/3D obs -> conv stack)."""
+    if len(obs_shape) >= 2:
+        return CNNModel(obs_shape, num_actions)
+    return MLPModel(obs_shape, num_actions, hidden)

@@ -40,6 +40,9 @@ class PPOConfig:
         self.hidden = 64
         self.seed = 0
         self.mesh = None
+        # Optional models.* instance; None = pick by obs shape (MLP for 1D,
+        # CNN for image observations — reference: catalog.py dispatch).
+        self.model_spec = None
 
     def environment(self, env: Any) -> "PPOConfig":
         self.env_spec = env
@@ -60,13 +63,13 @@ class PPOConfig:
                  entropy_coeff: Optional[float] = None,
                  num_epochs: Optional[int] = None,
                  minibatch_size: Optional[int] = None,
-                 mesh=None) -> "PPOConfig":
+                 mesh=None, model=None) -> "PPOConfig":
         for name, val in (("lr", lr), ("gamma", gamma), ("lambda_", lambda_),
                           ("clip_param", clip_param),
                           ("entropy_coeff", entropy_coeff),
                           ("num_epochs", num_epochs),
                           ("minibatch_size", minibatch_size),
-                          ("mesh", mesh)):
+                          ("mesh", mesh), ("model_spec", model)):
             if val is not None:
                 setattr(self, name, val)
         return self
@@ -83,18 +86,35 @@ class PPO:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         self.config = config
+        # Driver-side env probe: obs/action spaces come from a local env
+        # instance, not a throwaway actor (reference: the algorithm reads
+        # spaces from the env spec before building the EnvRunnerGroup).
+        probe_env = make_env(config.env_spec, seed=config.seed)
+        info = {
+            "observation_size": probe_env.observation_size,
+            "observation_shape": tuple(getattr(
+                probe_env, "observation_shape",
+                (probe_env.observation_size,))),
+            "num_actions": probe_env.num_actions,
+        }
+        del probe_env
+        model = config.model_spec
+        if model is None:
+            from .models import default_model
+
+            model = default_model(info["observation_shape"],
+                                  info["num_actions"], config.hidden)
         self.runners = [
             EnvRunner.remote(config.env_spec, config.num_envs_per_runner,
-                             seed=config.seed + i)
+                             seed=config.seed + i, model=model)
             for i in range(config.num_env_runners)
         ]
-        info = ray_tpu.get(self.runners[0].env_info.remote())
         self.learner = PPOLearner(
             info["observation_size"], info["num_actions"],
             lr=config.lr, clip_param=config.clip_param,
             vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
             grad_clip=config.grad_clip, hidden=config.hidden,
-            seed=config.seed, mesh=config.mesh,
+            seed=config.seed, mesh=config.mesh, model=model,
         )
         self._sync_weights()
         self.iteration = 0
@@ -104,7 +124,7 @@ class PPO:
     def _sync_weights(self):
         """Broadcast learner weights once via the object store; every runner
         reads the same copy (reference: env_runner_group.sync_weights)."""
-        ref = ray_tpu.put(list(self.learner.get_weights()))
+        ref = ray_tpu.put(self.learner.get_weights())
         ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
 
     def train(self) -> Dict[str, Any]:
@@ -129,7 +149,7 @@ class PPO:
                 cfg.gamma, cfg.lambda_,
             )
             T, N = s["rewards"].shape
-            flat["obs"].append(s["obs"].reshape(T * N, -1))
+            flat["obs"].append(s["obs"].reshape(T * N, *s["obs"].shape[2:]))
             flat["actions"].append(s["actions"].reshape(-1))
             flat["logp_old"].append(s["logp_old"].reshape(-1))
             flat["advantages"].append(adv.reshape(-1))

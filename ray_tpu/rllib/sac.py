@@ -1,0 +1,503 @@
+"""SAC: continuous-action soft actor-critic with a squashed-Gaussian policy.
+
+Role-equivalent to the reference's SAC
+(reference: rllib/algorithms/sac/sac.py:31 — off-policy, twin Q networks,
+tanh-squashed Gaussian actor, automatic entropy-temperature tuning
+sac.py:524 validates continuous action spaces; sac_torch_learner computes
+the actor/critic/alpha losses).  TPU-first shape: the entire update (actor,
+twin critics, alpha, polyak targets) is ONE jitted function; the replay
+batch is the only host<->device traffic.
+
+Includes PendulumEnv — the classic continuous-control benchmark (public
+textbook dynamics, same constants as gym's pendulum.py) since the image
+carries no gymnasium.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+
+class PendulumEnv:
+    """Pendulum-v1 semantics: swing up and hold; obs [cos th, sin th,
+    thdot], action torque in [-2, 2], reward -(th^2 + .1 thdot^2 + .001
+    a^2), 200-step episodes (truncation only)."""
+
+    observation_size = 3
+    observation_shape = (3,)
+    action_size = 1
+    action_low = -2.0
+    action_high = 2.0
+    max_episode_steps = 200
+
+    MAX_SPEED = 8.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.th = 0.0
+        self.thdot = 0.0
+        self.steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([math.cos(self.th), math.sin(self.th), self.thdot],
+                        np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.th = float(self.rng.uniform(-math.pi, math.pi))
+        self.thdot = float(self.rng.uniform(-1.0, 1.0))
+        self.steps = 0
+        return self._obs()
+
+    def step(self, action) -> Tuple[np.ndarray, float, bool, bool]:
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          self.action_low, self.action_high))
+        th_norm = ((self.th + math.pi) % (2 * math.pi)) - math.pi
+        cost = th_norm**2 + 0.1 * self.thdot**2 + 0.001 * u**2
+        acc = (3 * self.G / (2 * self.L) * math.sin(self.th)
+               + 3.0 / (self.M * self.L**2) * u)
+        self.thdot = float(np.clip(self.thdot + acc * self.DT,
+                                   -self.MAX_SPEED, self.MAX_SPEED))
+        self.th += self.thdot * self.DT
+        self.steps += 1
+        return self._obs(), -cost, False, self.steps >= self.max_episode_steps
+
+
+class SACParams(NamedTuple):
+    actor: Any
+    q1: Any
+    q2: Any
+    q1_target: Any
+    q2_target: Any
+    log_alpha: Any
+
+
+def _mlp_init(key, sizes):
+    import jax
+
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    he = jax.nn.initializers.he_normal()
+    for k, (m, n) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        import jax.numpy as jnp
+
+        params.append({"w": he(k, (m, n), jnp.float32),
+                       "b": jnp.zeros(n)})
+    return params
+
+
+def _mlp_apply(params, x, final_act=None):
+    import jax
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+class SACLearner:
+    """Twin-Q + squashed-Gaussian actor + auto-alpha, one jitted update.
+
+    reference: sac_torch_learner.py compute_loss_for_module — critic target
+    uses min(Q1', Q2') - alpha * logp of a fresh next-action sample; actor
+    maximizes min(Q) - alpha * logp; alpha tracks -|A| target entropy."""
+
+    LOG_STD_MIN = -20.0
+    LOG_STD_MAX = 2.0
+
+    def __init__(self, obs_size: int, action_size: int, *,
+                 action_low: float, action_high: float,
+                 lr: float = 3e-4, gamma: float = 0.99, tau: float = 0.005,
+                 hidden: int = 256, seed: int = 0,
+                 target_entropy: Optional[float] = None):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.gamma = gamma
+        self.tau = tau
+        self.action_size = action_size
+        self.scale = (action_high - action_low) / 2.0
+        self.bias = (action_high + action_low) / 2.0
+        self.target_entropy = (-float(action_size)
+                               if target_entropy is None else target_entropy)
+        k = jax.random.split(jax.random.PRNGKey(seed), 3)
+        actor = _mlp_init(k[0], [obs_size, hidden, hidden, 2 * action_size])
+        q1 = _mlp_init(k[1], [obs_size + action_size, hidden, hidden, 1])
+        q2 = _mlp_init(k[2], [obs_size + action_size, hidden, hidden, 1])
+        self.params = SACParams(
+            actor=actor, q1=q1, q2=q2,
+            q1_target=jax.tree.map(lambda x: x, q1),
+            q2_target=jax.tree.map(lambda x: x, q2),
+            log_alpha=jnp.zeros(()),
+        )
+        self.tx = optax.adam(lr)
+        self.opt_state = {
+            "actor": self.tx.init(self.params.actor),
+            "q1": self.tx.init(self.params.q1),
+            "q2": self.tx.init(self.params.q2),
+            "alpha": self.tx.init(self.params.log_alpha),
+        }
+        self._rng_key = jax.random.PRNGKey(seed + 7)
+        self._update = self._build_update()
+
+    # -- policy math ---------------------------------------------------------
+
+    @staticmethod
+    def _dist(actor_params, obs, action_size, lo=-20.0, hi=2.0):
+        import jax.numpy as jnp
+
+        out = _mlp_apply(actor_params, obs)
+        mu, log_std = out[..., :action_size], out[..., action_size:]
+        return mu, jnp.clip(log_std, lo, hi)
+
+    def _sample_action(self, actor_params, obs, key):
+        """Reparameterized tanh-Gaussian sample + log-prob (with the tanh
+        Jacobian correction)."""
+        import jax
+        import jax.numpy as jnp
+
+        mu, log_std = self._dist(actor_params, obs, self.action_size)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        a = jnp.tanh(pre)
+        logp = (-0.5 * (eps**2 + 2 * log_std + math.log(2 * math.pi))
+                ).sum(-1)
+        logp -= jnp.log(1 - a**2 + 1e-6).sum(-1)
+        return a * self.scale + self.bias, logp
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        gamma, tau, tx = self.gamma, self.tau, self.tx
+        tgt_ent, scale, bias = self.target_entropy, self.scale, self.bias
+
+        def q_apply(qp, obs, act):
+            return _mlp_apply(qp, jnp.concatenate(
+                [obs, (act - bias) / scale], -1))[..., 0]
+
+        def update(params: SACParams, opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(params.log_alpha)
+
+            # Critic target: r + gamma (1-d) [min Q'(s', a') - alpha logp].
+            next_a, next_logp = self._sample_action(params.actor,
+                                                    batch["next_obs"], k1)
+            q_next = jnp.minimum(
+                q_apply(params.q1_target, batch["next_obs"], next_a),
+                q_apply(params.q2_target, batch["next_obs"], next_a),
+            ) - alpha * next_logp
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * q_next
+            target = jax.lax.stop_gradient(target)
+
+            def q_loss(qp):
+                return ((q_apply(qp, batch["obs"], batch["actions"])
+                         - target) ** 2).mean()
+
+            q1_l, g1 = jax.value_and_grad(q_loss)(params.q1)
+            q2_l, g2 = jax.value_and_grad(q_loss)(params.q2)
+            up1, os_q1 = tx.update(g1, opt_state["q1"], params.q1)
+            up2, os_q2 = tx.update(g2, opt_state["q2"], params.q2)
+            q1_new = optax.apply_updates(params.q1, up1)
+            q2_new = optax.apply_updates(params.q2, up2)
+
+            # Actor: maximize min Q(s, pi(s)) - alpha logp.
+            def actor_loss(ap):
+                a, logp = self._sample_action(ap, batch["obs"], k2)
+                q = jnp.minimum(q_apply(q1_new, batch["obs"], a),
+                                q_apply(q2_new, batch["obs"], a))
+                return (alpha * logp - q).mean(), logp
+
+            (a_l, logp), ga = jax.value_and_grad(
+                actor_loss, has_aux=True)(params.actor)
+            upa, os_a = tx.update(ga, opt_state["actor"], params.actor)
+            actor_new = optax.apply_updates(params.actor, upa)
+
+            # Temperature: drive E[-logp] toward the target entropy.
+            def alpha_loss(log_alpha):
+                return -(jnp.exp(log_alpha)
+                         * jax.lax.stop_gradient(logp + tgt_ent)).mean()
+
+            al_l, gal = jax.value_and_grad(alpha_loss)(params.log_alpha)
+            upal, os_al = tx.update(gal, opt_state["alpha"],
+                                    params.log_alpha)
+            log_alpha_new = optax.apply_updates(params.log_alpha, upal)
+
+            # Polyak targets.
+            q1_t = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                params.q1_target, q1_new)
+            q2_t = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                params.q2_target, q2_new)
+            new_params = SACParams(actor_new, q1_new, q2_new, q1_t, q2_t,
+                                   log_alpha_new)
+            new_os = {"actor": os_a, "q1": os_q1, "q2": os_q2,
+                      "alpha": os_al}
+            aux = {"critic_loss": q1_l + q2_l, "actor_loss": a_l,
+                   "alpha": alpha, "entropy": -logp.mean()}
+            return new_params, new_os, aux
+
+        return jax.jit(update)
+
+    # -- API -----------------------------------------------------------------
+
+    def act(self, obs: np.ndarray, *, deterministic: bool = False):
+        """Host-side action selection for env runners."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_act_fn"):
+            def act_fn(actor, obs, key, det):
+                mu, log_std = self._dist(actor, obs, self.action_size)
+                eps = jax.random.normal(key, mu.shape)
+                pre = jnp.where(det, mu, mu + jnp.exp(log_std) * eps)
+                return jnp.tanh(pre) * self.scale + self.bias
+
+            self._act_fn = jax.jit(act_fn, static_argnames=("det",))
+        import jax
+
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return np.asarray(self._act_fn(self.params.actor, obs, sub,
+                                       deterministic))
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]):
+        import jax
+        import jax.numpy as jnp
+
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        mb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, mb, sub)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_actor_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params.actor)
+
+
+@ray_tpu.remote
+class ContinuousEnvRunner:
+    """Vectorized continuous-action rollout actor (SAC's off-policy runner;
+    reference: single_agent_env_runner used by SAC with a connector turning
+    episodes into transitions)."""
+
+    def __init__(self, env_cls, num_envs: int, *, action_size: int,
+                 scale: float, bias: float, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.envs = [env_cls(seed=seed * 1000 + i) for i in range(num_envs)]
+        self.obs = np.stack([e.reset() for e in self.envs])
+        self.num_envs = num_envs
+        self.action_size = action_size
+        self.scale = scale
+        self.bias = bias
+        self._rng = np.random.default_rng(seed + 1)
+        self._actor = None
+        self._fwd = None
+        self.episode_returns = np.zeros(num_envs)
+        self.completed: List[float] = []
+
+    def set_actor_weights(self, weights, log_std_clip=(-20.0, 2.0)) -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        self._actor = jax.tree.map(jnp.asarray, weights)
+        if self._fwd is None:
+            lo, hi = log_std_clip
+            asize, scale, bias = self.action_size, self.scale, self.bias
+
+            def fwd(actor, obs, eps):
+                out = _mlp_apply(actor, obs)
+                mu, log_std = out[..., :asize], jnp.clip(
+                    out[..., asize:], lo, hi)
+                return jnp.tanh(mu + jnp.exp(log_std) * eps) * scale + bias
+
+            self._fwd = jax.jit(fwd)
+        return True
+
+    def sample_transitions(self, num_steps: int,
+                           random_actions: bool = False):
+        N = self.num_envs
+        D = self.obs.shape[1]
+        obs_b = np.empty((num_steps, N, D), np.float32)
+        next_b = np.empty((num_steps, N, D), np.float32)
+        act_b = np.empty((num_steps, N, self.action_size), np.float32)
+        rew_b = np.empty((num_steps, N), np.float32)
+        done_b = np.zeros((num_steps, N), np.float32)
+        for t in range(num_steps):
+            if random_actions or self._actor is None:
+                acts = self._rng.uniform(
+                    self.bias - self.scale, self.bias + self.scale,
+                    (N, self.action_size)).astype(np.float32)
+            else:
+                eps = self._rng.standard_normal(
+                    (N, self.action_size)).astype(np.float32)
+                acts = np.asarray(self._fwd(self._actor, self.obs, eps))
+            obs_b[t] = self.obs
+            act_b[t] = acts
+            for i, env in enumerate(self.envs):
+                o, r, term, trunc = env.step(acts[i])
+                rew_b[t, i] = r
+                self.episode_returns[i] += r
+                # done=termination only; truncation still bootstraps.
+                done_b[t, i] = float(term)
+                next_b[t, i] = o
+                if term or trunc:
+                    self.completed.append(float(self.episode_returns[i]))
+                    self.episode_returns[i] = 0.0
+                    o = env.reset()
+                self.obs[i] = o
+        out, self.completed = self.completed, []
+        return {
+            "obs": obs_b.reshape(-1, D),
+            "next_obs": next_b.reshape(-1, D),
+            "actions": act_b.reshape(-1, self.action_size),
+            "rewards": rew_b.reshape(-1),
+            "dones": done_b.reshape(-1),
+            "episode_returns": np.asarray(out),
+        }
+
+
+class SACConfig:
+    def __init__(self):
+        self.env_cls = PendulumEnv
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 8
+        self.rollout_fragment_length = 32
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.hidden = 256
+        self.buffer_size = 100_000
+        self.batch_size = 256
+        self.updates_per_round = 16
+        self.warmup_steps = 1_000
+        self.seed = 0
+
+    def environment(self, env_cls) -> "SACConfig":
+        self.env_cls = env_cls
+        return self
+
+    def training(self, **kwargs) -> "SACConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown SAC option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """Off-policy loop: sample transitions -> replay buffer -> k jitted
+    updates -> actor-weight sync (reference: sac.py training_step)."""
+
+    def __init__(self, config: SACConfig):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        env = config.env_cls()
+        obs_size = env.observation_size
+        self.learner = SACLearner(
+            obs_size, env.action_size,
+            action_low=env.action_low, action_high=env.action_high,
+            lr=config.lr, gamma=config.gamma, tau=config.tau,
+            hidden=config.hidden, seed=config.seed,
+        )
+        scale = (env.action_high - env.action_low) / 2.0
+        bias = (env.action_high + env.action_low) / 2.0
+        self.runners = [
+            ContinuousEnvRunner.remote(
+                config.env_cls, config.num_envs_per_runner,
+                action_size=env.action_size, scale=scale, bias=bias,
+                seed=config.seed + i)
+            for i in range(config.num_env_runners)
+        ]
+        self._rng = np.random.default_rng(config.seed)
+        self._buffer: Dict[str, np.ndarray] = {}
+        self._buf_n = 0
+        self._sync_weights()
+        self.iteration = 0
+        self.total_env_steps = 0
+        self._recent: List[float] = []
+
+    def _sync_weights(self):
+        ref = ray_tpu.put(self.learner.get_actor_weights())
+        ray_tpu.get([r.set_actor_weights.remote(ref) for r in self.runners])
+
+    def _add_to_buffer(self, batch):
+        n = len(batch["rewards"])
+        cap = self.config.buffer_size
+        if not self._buffer:
+            self._buffer = {
+                k: np.empty((cap, *v.shape[1:]), v.dtype)
+                for k, v in batch.items() if k != "episode_returns"
+            }
+            self._pos = 0
+        for k, buf in self._buffer.items():
+            data = batch[k]
+            idx = (self._pos + np.arange(n)) % cap
+            buf[idx] = data
+        self._pos = (self._pos + n) % cap
+        self._buf_n = min(self._buf_n + n, cap)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        warmup = self.total_env_steps < cfg.warmup_steps
+        samples = ray_tpu.get([
+            r.sample_transitions.remote(cfg.rollout_fragment_length,
+                                        random_actions=warmup)
+            for r in self.runners
+        ])
+        for s in samples:
+            self._recent.extend(s.pop("episode_returns").tolist())
+            self._add_to_buffer(s)
+            self.total_env_steps += len(s["rewards"])
+        self._recent = self._recent[-50:]
+
+        metrics: Dict[str, float] = {}
+        if self._buf_n >= cfg.batch_size and not warmup:
+            for _ in range(cfg.updates_per_round):
+                idx = self._rng.integers(0, self._buf_n, cfg.batch_size)
+                mb = {k: v[idx] for k, v in self._buffer.items()}
+                metrics = self.learner.update_from_batch(mb)
+            self._sync_weights()
+        self.iteration += 1
+        wall = time.perf_counter() - t0
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self.total_env_steps,
+            "episode_return_mean": (float(np.mean(self._recent))
+                                    if self._recent else float("nan")),
+            "env_steps_per_sec": (
+                len(samples) * cfg.rollout_fragment_length
+                * cfg.num_envs_per_runner / max(wall, 1e-9)),
+            **metrics,
+        }
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
