@@ -41,6 +41,21 @@ class NodeProvider:
     def node_id_of(self, handle: object) -> str:
         raise NotImplementedError
 
+    def node_ids_of(self, handle: object) -> List[str]:
+        """All cluster node ids backing one provider node.  Single-host
+        providers return [node_id_of(handle)]; slice providers (one
+        provider node = many hosts) override — the reconciler treats the
+        provider node as busy if ANY backing node is."""
+        return [self.node_id_of(handle)]
+
+    def host_resources(self) -> Optional[Dict[str, float]]:
+        """Resource shape of ONE host this provider can add, or None when
+        unknown.  The reconciler uses it to ignore demand no amount of
+        scaling can satisfy (reference: the autoscaler matches demand
+        against available_node_types resource shapes —
+        resource_demand_scheduler.py)."""
+        return None
+
 
 class LocalNodeProvider(NodeProvider):
     """Adds node-daemon processes on this machine."""
@@ -78,6 +93,9 @@ class LocalNodeProvider(NodeProvider):
     def node_id_of(self, handle) -> str:
         return handle.hex
 
+    def host_resources(self) -> Optional[Dict[str, float]]:
+        return {"CPU": float(self.num_cpus), **(self.resources or {})}
+
 
 class Autoscaler:
     """(reference: StandardAutoscaler.update — one reconcile step per tick)"""
@@ -114,17 +132,33 @@ class Autoscaler:
             for kind in ("tasks", "placement_groups", "nodes", "workers")
         }
 
-    @staticmethod
-    def _demand(snap: dict) -> int:
+    def _demand(self, snap: dict) -> int:
         """Unmet demand: runnable pending tasks (dep-blocked ones can't use
         a new node) plus pending placement groups (reference:
-        load_metrics.py resource demand vectors, simplified to counts)."""
+        load_metrics.py resource demand vectors, simplified to counts).
+
+        Demand that no provider node can EVER satisfy is excluded — a
+        placement group asking for {"CPU": 64} on a 2-CPU-host provider
+        would otherwise pin the cluster at max_nodes forever through the
+        never-drain-while-demand guard."""
         pending = sum(
             1 for t in snap["tasks"]
             if t.get("state") == "PENDING" and not t.get("dep_blocked")
         )
+        shape = self.provider.host_resources()
+
+        def scalable(pg: dict) -> bool:
+            if shape is None:
+                return True  # provider shape unknown: assume serviceable
+            return all(
+                res <= shape.get(k, 0.0)
+                for b in pg.get("bundles", [])
+                for k, res in (b.get("resources") or {}).items()
+            )
+
         pending_pgs = sum(
-            1 for p in snap["placement_groups"] if not p.get("created")
+            1 for p in snap["placement_groups"]
+            if not p.get("created") and scalable(p)
         )
         return pending + pending_pgs
 
@@ -161,14 +195,17 @@ class Autoscaler:
         for handle in nodes:
             if len(self.provider.non_terminated_nodes()) <= self.min_nodes:
                 break
-            hex_id = self.provider.node_id_of(handle)
-            if self._node_busy(snap, hex_id):
-                self._idle_since.pop(hex_id, None)
+            key = self.provider.node_id_of(handle)
+            # A multi-host provider node (TPU slice) is busy while ANY of
+            # its backing nodes is — slices scale atomically.
+            if any(self._node_busy(snap, h)
+                   for h in self.provider.node_ids_of(handle)):
+                self._idle_since.pop(key, None)
                 continue
-            first_idle = self._idle_since.setdefault(hex_id, now)
+            first_idle = self._idle_since.setdefault(key, now)
             if now - first_idle >= self.idle_timeout_s:
                 self.provider.terminate_node(handle)
-                self._idle_since.pop(hex_id, None)
+                self._idle_since.pop(key, None)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -873,6 +873,9 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     _ensure_init()
     reply = ctx.client.call("get_actor_by_name", {"name": name})
     if not reply["found"]:
+        tomb = reply.get("tombstone")
+        if tomb:
+            raise ValueError(f"actor {name!r}: {tomb}")
         raise ValueError(f"no actor with name {name!r}")
     spec = reply["spec"]
     return ActorHandle(

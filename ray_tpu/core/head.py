@@ -242,6 +242,12 @@ class Head:
         self.node_bulk_addrs: Dict[NodeID, str] = {}
         self.node_last_ack: Dict[NodeID, float] = {}
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
+        self._events_since_persist = 0
+        # Named actors that could NOT be restored after a head restart
+        # (constructor args lived in the dead session's object store):
+        # name -> human-readable reason, surfaced by get_actor(name)
+        # (reference: GCS actor table entries keep a death cause).
+        self.named_tombstones: Dict[str, str] = {}
         self._spawn_pending: Dict[NodeID, int] = {}
         self._spawn_times: Dict[NodeID, deque] = {}
         # Placement groups waiting for resources to free up (reference:
@@ -315,6 +321,14 @@ class Head:
     def _event(self, kind: str, **kw):
         if self.config.enable_timeline:
             self.task_events.append({"ts": time.time(), "kind": kind, **kw})
+            # Coarse durability cadence: the event log rides the snapshot,
+            # but marking dirty per event would re-pickle the whole state
+            # every tick under load.  Every 100th event is enough for a
+            # "recent timeline survives restart" guarantee.
+            self._events_since_persist += 1
+            if self._events_since_persist >= 100:
+                self._events_since_persist = 0
+                self._mark_dirty()
 
     def _obj(self, oid: ObjectID) -> ObjectRecord:
         rec = self.objects.get(oid)
@@ -1009,7 +1023,15 @@ class Head:
                for pg_id, body in self.pg_bodies.items()
                if body.get("lifetime") == "detached"}
         snapshot = {"kv": dict(self.kv), "named_actors": named,
-                    "pgs": pgs}
+                    "pgs": pgs,
+                    # Bounded task-event tail: `status`/timeline keep their
+                    # RECENT history across restarts (reference:
+                    # gcs_task_manager.h:86 task-event store in GCS).  The
+                    # snapshot carries a small tail, never the full 100k
+                    # ring — any kv/actor/PG dirty-flush would otherwise
+                    # re-pickle a multi-MB event blob every time.
+                    "task_events": list(self.task_events)[-2000:],
+                    "tombstones": dict(self.named_tombstones)}
 
         def dump():
             import cloudpickle
@@ -1037,6 +1059,11 @@ class Head:
         with open(path, "rb") as f:
             state = cloudpickle.loads(f.read())
         self.kv.update(state.get("kv", {}))
+        # Event history first, so restart markers sort after it.
+        for ev in state.get("task_events", []):
+            self.task_events.append(ev)
+        self._event("head_restarted")
+        self.named_tombstones.update(state.get("tombstones", {}))
         # PGs first: restored actors may target them.  Replaying the
         # creation body re-reserves bundles on the current node set; with
         # no nodes registered yet the PG queues in pending_pgs and is
@@ -1056,7 +1083,15 @@ class Head:
             if ct.get("arg_ids") or ct.get("args_ref"):
                 # Constructor args lived in the old session's shm — a
                 # resubmit would dep-block forever and wedge the name.
-                # Skip, so get_actor(name) fails fast instead.
+                # Tombstone it so get_actor(name) explains the loss
+                # instead of a bare "no actor with name".
+                self.named_tombstones[name] = (
+                    "lost in head restart: the actor's constructor "
+                    "arguments lived in the previous session's object "
+                    "store and are not durable; re-create it with "
+                    "inline-serializable arguments to survive restarts"
+                )
+                self._mark_dirty()
                 continue
             try:
                 await self.h_create_actor(None, spec)
@@ -2155,6 +2190,8 @@ class Head:
             if actor.name in self.named_actors:
                 raise ValueError(f"actor name {actor.name!r} already taken")
             self.named_actors[actor.name] = actor_id
+            # A fresh creation supersedes any restart-loss tombstone.
+            self.named_tombstones.pop(actor.name, None)
             self._mark_dirty()
         self.actors[actor_id] = actor
         await self.h_submit_task(conn, body["creation_task"])
@@ -2320,7 +2357,11 @@ class Head:
     async def h_get_actor_by_name(self, conn, body):
         actor_id = self.named_actors.get(body["name"])
         if actor_id is None:
-            return {"found": False}
+            reply = {"found": False}
+            tomb = self.named_tombstones.get(body["name"])
+            if tomb:
+                reply["tombstone"] = tomb
+            return reply
         actor = self.actors[actor_id]
         return {
             "found": True,
@@ -2654,9 +2695,31 @@ class Head:
                 for w in self.workers.values()
             ]}
         if kind == "placement_groups":
-            return {"items": list(
+            items = list(
                 self.scheduler.snapshot()["placement_groups"].values()
-            )}
+            )
+            # Queued (not-yet-placeable) PGs are cluster DEMAND — the
+            # autoscaler keys off them, so they must be visible here
+            # (reference: gcs_placement_group_manager pending queue feeds
+            # the autoscaler's resource demand report).
+            for pg_id, body in self.pending_pgs.items():
+                items.append({
+                    "pg_id": pg_id.hex(),
+                    "strategy": body.get("strategy", "PACK"),
+                    "created": False,
+                    "pending": True,
+                    # Current-node-set feasibility: lets demand consumers
+                    # (autoscaler) distinguish "needs more nodes" from
+                    # "waiting for busy resources to free".
+                    "infeasible_now": not self.scheduler.check_feasible_ever(
+                        body.get("bundles", []),
+                        body.get("strategy", "PACK")),
+                    "bundles": [
+                        {"resources": dict(r), "node": None}
+                        for r in body.get("bundles", [])
+                    ],
+                })
+            return {"items": items}
         if kind == "timeline":
             return {"items": list(self.task_events)}
         if kind == "metrics":

@@ -253,6 +253,22 @@ class MultiAgentEnvRunner:
                     # bootstrap from the last value estimate.
                     self.completed_returns.append(self._episode_return[a])
                     finish(a, val)
+            # Protocol: an agent absent from obs (it didn't act this step)
+            # may still receive a (final) reward — e.g. turn-based envs
+            # deliver it one step late.  Credit it to the agent's LAST
+            # acted step (multi_agent_env.py:96 step docs).
+            for a, r in rewards.items():
+                if a in step_info:
+                    continue
+                fr = frags.get(a)
+                if fr is not None and fr.rewards:
+                    fr.rewards[-1] += r
+                self._episode_return[a] = (
+                    self._episode_return.get(a, 0.0) + r)
+                if terms.get(a) or truncs.get(a):
+                    self.completed_returns.append(self._episode_return[a])
+                    finish(a, 0.0 if terms.get(a)
+                           else (fr.values[-1] if fr and fr.values else 0.0))
             self.obs = next_obs
 
         # Fragment boundary: bootstrap live agents from V(current obs).

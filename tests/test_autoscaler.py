@@ -54,3 +54,116 @@ def test_scale_up_then_down(rt):
         scaler.stop()
         for h in provider.non_terminated_nodes():
             provider.terminate_node(h)
+
+
+def test_tpu_slice_provider_scales_on_pg_demand(rt):
+    """Slice-granular scaling through the mock GCE API (reference:
+    gcp/node_provider.py + fake_multi_node): a pending STRICT_SPREAD
+    placement group needing TPU hosts drives creation of a whole v5p-16
+    slice (2 hosts, one API create call); idle timeout deletes the whole
+    slice (one API delete call)."""
+    from ray_tpu.autoscaler.gce import MockGceTpuApi, TpuSliceNodeProvider
+
+    api = MockGceTpuApi()
+    provider = TpuSliceNodeProvider(api, accelerator_type="v5p-16")
+    assert provider.hosts_per_slice == 2
+    scaler = Autoscaler(provider, min_nodes=0, max_nodes=2,
+                        idle_timeout_s=3.0, poll_interval_s=0.5)
+    scaler.start()
+    pg = None
+    try:
+        # Two TPU-host bundles on distinct nodes: unsatisfiable on the
+        # CPU-only head, so the PG parks as demand.
+        pg = ray_tpu.placement_group(
+            [{"CPU": 1, "TPU": 4}] * 2, strategy="STRICT_SPREAD")
+        assert pg.ready(timeout=90)  # resolved by the new slice
+
+        # ready() fires the moment the second host registers — a beat
+        # before create_node() returns and records the handle.
+        deadline = time.time() + 10
+        while time.time() < deadline and not provider.non_terminated_nodes():
+            time.sleep(0.2)
+        slices = provider.non_terminated_nodes()
+        assert len(slices) == 1  # ONE slice satisfied both bundles
+        assert len(slices[0].host_handles) == 2  # ...with two hosts
+
+        creates = [c for c in api.calls
+                   if c["method"].endswith("nodes.create")]
+        assert len(creates) == 1
+        assert creates[0]["accelerator_type"] == "v5p-16"
+        assert creates[0]["node_id"] == slices[0].slice_id
+        # The mock API models the slice lifecycle.
+        assert api.get(node_id=slices[0].slice_id)["state"] == "READY"
+
+        # Release the PG: the whole slice drains after the idle timeout.
+        ray_tpu.remove_placement_group(pg)
+        pg = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes() == []
+        deletes = [c for c in api.calls
+                   if c["method"].endswith("nodes.delete")]
+        assert len(deletes) == 1
+        assert deletes[0]["node_id"] == creates[0]["node_id"]
+    finally:
+        scaler.stop()
+        if pg is not None:
+            try:
+                ray_tpu.remove_placement_group(pg)
+            except Exception:
+                pass
+        for h in provider.non_terminated_nodes():
+            provider.terminate_node(h)
+
+
+def test_tpu_slice_provider_atomic_rollback():
+    """A slice whose host join fails rolls back completely: no half-slices
+    in the provider, and the API node is deleted."""
+    from ray_tpu.autoscaler.gce import MockGceTpuApi, TpuSliceNodeProvider
+
+    api = MockGceTpuApi()
+    provider = TpuSliceNodeProvider(api, accelerator_type="v5p-16",
+                                    join_cluster=False)
+
+    class FailingCluster:
+        def __init__(self):
+            self.added = 0
+
+        def add_node(self, **kw):
+            self.added += 1
+            if self.added == 2:
+                raise RuntimeError("host 2 failed to boot")
+            return type("H", (), {"hex": f"h{self.added}"})()
+
+        def remove_node(self, h, graceful=True):
+            pass
+
+    provider._cluster = FailingCluster()
+    with pytest.raises(RuntimeError, match="host 2"):
+        provider.create_node()
+    assert provider.non_terminated_nodes() == []
+    assert api.nodes == {}  # create was compensated by delete
+    methods = [c["method"].rsplit(".", 1)[-1] for c in api.calls]
+    assert methods == ["create", "delete"]
+
+
+def test_unscalable_demand_does_not_pin_cluster(rt):
+    """A placement group no provider node can ever hold must not drive
+    scale-up (or hold idle nodes at max forever): demand no amount of
+    scaling can satisfy is excluded from the reconciler's count."""
+    from ray_tpu.autoscaler import LocalNodeProvider
+
+    provider = LocalNodeProvider(num_cpus=2)
+    scaler = Autoscaler(provider, min_nodes=0, max_nodes=2,
+                        idle_timeout_s=1.0, poll_interval_s=0.5)
+    pg = ray_tpu.placement_group([{"CPU": 64}], strategy="PACK")
+    try:
+        for _ in range(5):
+            scaler.update()
+            time.sleep(0.2)
+        assert provider.non_terminated_nodes() == []  # never scaled for it
+    finally:
+        ray_tpu.remove_placement_group(pg)

@@ -272,6 +272,23 @@ class Durable:
 d = Durable.options(name="kill9-actor", lifetime="detached").remote("v9")
 assert ray_tpu.get(d.get_tag.remote(), timeout=30) == "v9"
 
+# A named actor whose ctor arg lives in the object store: NOT restorable
+# after restart — must yield an explanatory tombstone, not a bare miss.
+big_arg = ray_tpu.put(list(range(50_000)))  # too big to inline
+Durable.options(name="kill9-lost", lifetime="detached").remote(big_arg)
+
+# A submitted job: its status/entrypoint rows live in the durable KV.
+from ray_tpu.job_submission import JobSubmissionClient
+job_id = JobSubmissionClient().submit_job(
+    entrypoint="python -c 'print(42)'", job_id="kill9-job")
+
+# Task churn so the timeline has pre-restart events.
+@ray_tpu.remote
+def noop(i):
+    return i
+assert sorted(ray_tpu.get([noop.remote(i) for i in range(20)],
+                          timeout=30)) == list(range(20))
+
 # One satisfiable PG and one that can't fit until the cluster grows.
 ok_pg = ray_tpu.placement_group([{{"CPU": 1}}], strategy="PACK",
                                 lifetime="detached")
@@ -280,6 +297,8 @@ big_pg = ray_tpu.placement_group([{{"CPU": 64}}], strategy="PACK",
                                  lifetime="detached")
 ctx.client.kv_put("kill9-ok-pg", pickle.dumps(ok_pg))
 ctx.client.kv_put("kill9-big-pg", pickle.dumps(big_pg))
+# The kv_puts marked the snapshot dirty; the periodic persist flushes it
+# (the event tail rides the same snapshot).
 time.sleep(3)  # let the periodic persist flush the dirty snapshot
 print("READY", flush=True)
 time.sleep(30)  # killed long before this expires
@@ -331,6 +350,27 @@ time.sleep(30)  # killed long before this expires
         assert ok_pg.ready(timeout=30)
         # The infeasible PG is STILL PENDING (not lost, not satisfied).
         assert not big_pg.ready(timeout=2)
+
+        # Durable control plane v3 --------------------------------------
+        # (a) The job table (KV-backed) survives: status + entrypoint.
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        jc = JobSubmissionClient()
+        assert jc.get_job_status("kill9-job") in (
+            "PENDING", "RUNNING", "SUCCEEDED", "FAILED")
+        assert (ctx.client.kv_get("job:kill9-job:entrypoint")
+                == b"python -c 'print(42)'")
+        # (b) The recent task timeline survives, with a restart marker
+        #     sorting after the pre-kill events.
+        events = ctx.client.call("list_state", {"kind": "timeline"})["items"]
+        kinds = [e["kind"] for e in events]
+        assert "head_restarted" in kinds
+        assert any(k != "head_restarted"
+                   for k in kinds[:kinds.index("head_restarted")]), (
+            "no pre-restart events survived")
+        # (c) The shm-arg actor was NOT restorable — and says why.
+        with pytest.raises(ValueError, match="lost in head restart"):
+            rt.get_actor("kill9-lost")
     finally:
         rt.shutdown()
 
