@@ -56,6 +56,10 @@ class NodeProvider:
         resource_demand_scheduler.py)."""
         return None
 
+    def hosts_per_node(self) -> int:
+        """Cluster hosts one provider node contributes (slices > 1)."""
+        return 1
+
 
 class LocalNodeProvider(NodeProvider):
     """Adds node-daemon processes on this machine."""
@@ -146,14 +150,28 @@ class Autoscaler:
             if t.get("state") == "PENDING" and not t.get("dep_blocked")
         )
         shape = self.provider.host_resources()
+        max_hosts = self.max_nodes * max(1, self.provider.hosts_per_node())
 
         def scalable(pg: dict) -> bool:
             if shape is None:
                 return True  # provider shape unknown: assume serviceable
+            bundles = [b.get("resources") or {}
+                       for b in pg.get("bundles", [])]
+            strategy = pg.get("strategy", "PACK")
+            if strategy == "STRICT_PACK":
+                # All bundles must co-locate on ONE host: their SUM must
+                # fit the host shape.
+                need: Dict[str, float] = {}
+                for b in bundles:
+                    for k, v in b.items():
+                        need[k] = need.get(k, 0.0) + v
+                return all(v <= shape.get(k, 0.0) for k, v in need.items())
+            if strategy == "STRICT_SPREAD" and len(bundles) > max_hosts:
+                return False  # more distinct nodes than scaling can add
             return all(
                 res <= shape.get(k, 0.0)
-                for b in pg.get("bundles", [])
-                for k, res in (b.get("resources") or {}).items()
+                for b in bundles
+                for k, res in b.items()
             )
 
         pending_pgs = sum(

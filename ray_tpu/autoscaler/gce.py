@@ -212,6 +212,9 @@ class TpuSliceNodeProvider(NodeProvider):
         return {"CPU": float(self.num_cpus_per_host),
                 "TPU": float(self.chips_per_host)}
 
+    def hosts_per_node(self) -> int:
+        return self.hosts_per_slice
+
     def node_ids_of(self, handle: _SliceHandle) -> List[str]:
         """Every cluster node hex backing this slice — a slice is busy if
         ANY of its hosts is (the reconciler must not tear down a slice

@@ -199,8 +199,48 @@ class _HttpProxy:
         handles: Dict[str, DeploymentHandle] = {}
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def _stream_sse(self, h: DeploymentHandle, payload):
+                """Server-sent events over a generator deployment
+                (reference: proxy.py:537-598 — the HTTP proxy streams
+                responses chunk-by-chunk as the replica produces them).
+                One `data:` frame per yielded item, flushed immediately;
+                buffering is one item in this thread, the rest in the
+                object store."""
+                gen_handle = h.options(stream=True)
+                if isinstance(payload, dict):
+                    stream = gen_handle.remote(**payload)
+                elif payload is None:
+                    stream = gen_handle.remote()
+                else:
+                    stream = gen_handle.remote(payload)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for item in stream:
+                        self.wfile.write(
+                            b"data: " + json.dumps(item).encode() + b"\n\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"event: done\ndata: null\n\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client hung up mid-stream: stop consuming
+                except Exception as e:  # noqa: BLE001 — headers are out;
+                    # the error must travel IN the stream, not as a status.
+                    try:
+                        self.wfile.write(
+                            b"event: error\ndata: "
+                            + json.dumps(str(e)).encode() + b"\n\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+
             def do_POST(self):  # noqa: N802 — stdlib naming
                 name = self.path.strip("/").split("/")[0]
+                want_stream = "text/event-stream" in (
+                    self.headers.get("Accept") or "")
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n)
@@ -208,6 +248,9 @@ class _HttpProxy:
                     h = handles.get(name)
                     if h is None:
                         h = handles[name] = DeploymentHandle(name)
+                    if want_stream:
+                        self._stream_sse(h, payload)
+                        return
                     if isinstance(payload, dict):
                         resp = h.remote(**payload).result()
                     elif payload is None:

@@ -26,6 +26,11 @@ from concurrent import futures
 from typing import Dict, Optional
 
 CALL_METHOD = "/ray_tpu.serve.ServeAPI/Call"
+# Server-streaming variant: same request body, one JSON frame per item the
+# generator deployment yields: {"item": <json>} ... {"done": true}
+# (reference: proxy.py:537-598 — the gRPC proxy's streaming responses are
+# the main reason a model server wants gRPC: token streaming).
+CALL_STREAM_METHOD = "/ray_tpu.serve.ServeAPI/CallStream"
 
 
 class _GrpcIngress:
@@ -45,14 +50,34 @@ class _GrpcIngress:
         handles_lock = threading.Lock()
         max_handles = 256
 
+        def _abort_for(e: BaseException, context):
+            """Shared exception -> gRPC status mapping for both methods."""
+            if isinstance(e, RuntimeError) and "no running replicas" in str(e):
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
         def call(request: bytes, context):
+            req, h = _route(request, context)
+            try:
+                result = h.remote(
+                    *(req.get("args") or []), **(req.get("kwargs") or {})
+                ).result()
+                # Serialize inside the mapping too: a non-JSON result
+                # (arrays, bytes) must answer INTERNAL with the reason,
+                # not a blank UNKNOWN.
+                return json.dumps({"result": result}).encode()
+            except Exception as e:  # noqa: BLE001 — mapped to a status
+                _abort_for(e, context)
+
+        def _route(request: bytes, context):
+            """Shared request parse + handle lookup for both methods."""
             try:
                 req = json.loads(request)
                 if not isinstance(req, dict):
                     raise TypeError(
                         f"body must be a JSON object, got "
-                        f"{type(req).__name__}"
-                    )
+                        f"{type(req).__name__}")
                 name = req["deployment"]
             except (ValueError, KeyError, TypeError) as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
@@ -64,9 +89,6 @@ class _GrpcIngress:
                 if h is not None:
                     handles.move_to_end(key)
             if h is None:
-                # First request for this route: verify the deployment
-                # exists so an unknown name fails fast instead of waiting
-                # out the router's replica deadline.
                 from .api import status as serve_status
 
                 try:
@@ -77,29 +99,30 @@ class _GrpcIngress:
                     context.abort(grpc.StatusCode.NOT_FOUND,
                                   f"no deployment named {name!r}")
                 h = DeploymentHandle(
-                    name, key[1], multiplexed_model_id=key[2]
-                )
+                    name, key[1], multiplexed_model_id=key[2])
                 with handles_lock:
-                    h = handles.setdefault(key, h)  # lost race: reuse winner
+                    h = handles.setdefault(key, h)
                     handles.move_to_end(key)
                     while len(handles) > max_handles:
                         handles.popitem(last=False)
+            return req, h
+
+        def call_stream(request: bytes, context):
+            """unary_stream: one response frame per generator item.  The
+            stream is pulled item-by-item (consumer-side buffering is one
+            item; the rest waits in the object store), so a slow client
+            applies backpressure to this worker thread only."""
+            req, h = _route(request, context)
             try:
-                result = h.remote(
-                    *(req.get("args") or []), **(req.get("kwargs") or {})
-                ).result()
-                # Serialize inside the mapping too: a non-JSON result
-                # (arrays, bytes) must answer INTERNAL with the reason,
-                # not a blank UNKNOWN.
-                return json.dumps({"result": result}).encode()
-            except RuntimeError as e:
-                if "no running replicas" in str(e):
-                    context.abort(grpc.StatusCode.NOT_FOUND, str(e))
-                context.abort(grpc.StatusCode.INTERNAL,
-                              f"{type(e).__name__}: {e}")
-            except Exception as e:  # noqa: BLE001 — surfaces as INTERNAL
-                context.abort(grpc.StatusCode.INTERNAL,
-                              f"{type(e).__name__}: {e}")
+                stream = h.options(stream=True).remote(
+                    *(req.get("args") or []), **(req.get("kwargs") or {}))
+                for item in stream:
+                    if not context.is_active():
+                        return  # client cancelled: stop consuming
+                    yield json.dumps({"item": item}).encode()
+                yield json.dumps({"done": True}).encode()
+            except Exception as e:  # noqa: BLE001 — mapped to a status
+                _abort_for(e, context)
 
         class Handler(grpc.GenericRpcHandler):
             def service(self, details):
@@ -107,6 +130,12 @@ class _GrpcIngress:
                     return grpc.unary_unary_rpc_method_handler(
                         call,
                         request_deserializer=None,   # raw bytes
+                        response_serializer=None,
+                    )
+                if details.method == CALL_STREAM_METHOD:
+                    return grpc.unary_stream_rpc_method_handler(
+                        call_stream,
+                        request_deserializer=None,
                         response_serializer=None,
                     )
                 return None

@@ -52,12 +52,35 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call's items (reference:
+    serve/handle.py DeploymentResponseGenerator over an
+    ObjectRefGenerator).  Buffering is consumer-side one-item-at-a-time;
+    produced-but-unconsumed items wait in the object store (spill-bounded),
+    never in this process.  No mid-stream replica retry: a stream is
+    stateful, so a replica death surfaces to the caller."""
+
+    def __init__(self, ref_gen, done_cb=None):
+        self._gen = ref_gen
+        self._done_cb = done_cb
+
+    def __iter__(self):
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref)
+        finally:
+            if self._done_cb is not None:
+                self._done_cb()
+                self._done_cb = None
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.deployment_name = deployment_name
         self.method = method
         self.multiplexed_model_id = multiplexed_model_id
+        self.stream = stream
         self._replicas: List[Any] = []
         self._version = -1
         self._last_refresh = 0.0
@@ -65,14 +88,18 @@ class DeploymentHandle:
         self._lock = threading.Lock()
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
-        """(reference: serve/handle.py .options — method_name and
-        multiplexed_model_id are the supported knobs here)."""
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        """(reference: serve/handle.py .options — method_name,
+        multiplexed_model_id and stream are the supported knobs here;
+        stream=True makes .remote() return a DeploymentResponseGenerator
+        over a generator deployment's items)."""
         return DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self.method,
             multiplexed_model_id if multiplexed_model_id is not None
             else self.multiplexed_model_id,
+            stream if stream is not None else self.stream,
         )
 
     def _refresh(self, force: bool = False):
@@ -136,11 +163,19 @@ class DeploymentHandle:
                 if i in self._local_load:
                     self._local_load[i] = max(0, self._local_load[i] - 1)
 
-        try:
-            ref = replica.handle_request.remote(
+        def submit(rep):
+            if self.stream:
+                return rep.handle_request_streaming.options(
+                    num_returns="streaming"
+                ).remote(self.method, args, kwargs,
+                         model_id=self.multiplexed_model_id)
+            return rep.handle_request.remote(
                 self.method, args, kwargs,
                 model_id=self.multiplexed_model_id,
             )
+
+        try:
+            ref = submit(replica)
         except Exception:
             done()
             # Replica likely died: force-refresh and retry once.
@@ -151,10 +186,13 @@ class DeploymentHandle:
                 idx = self._pick()
                 replica = self._replicas[idx]
                 self._local_load[idx] = self._local_load.get(idx, 0) + 1
-            ref = replica.handle_request.remote(
-                self.method, args, kwargs,
-                model_id=self.multiplexed_model_id,
-            )
+                # done() must release THIS replica's count, not the dead
+                # one's (already released above).
+                state["idx"] = idx
+            ref = submit(replica)
+
+        if self.stream:
+            return DeploymentResponseGenerator(ref, done)
 
         def retry():
             self._refresh(force=True)
@@ -185,4 +223,4 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.method,
-                 self.multiplexed_model_id))
+                 self.multiplexed_model_id, self.stream))

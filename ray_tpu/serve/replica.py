@@ -37,20 +37,38 @@ class ServeReplica:
         pow_2_scheduler.py)."""
         return self._ongoing
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict,
-                             model_id: str = ""):
+    def _resolve_target(self, method: str):
+        if self.user_fn is not None:
+            return self.user_fn
+        if method == "__call__":
+            return self.user
+        return getattr(self.user, method)
+
+    def _request_scope(self, model_id: str):
+        """Ongoing-count + multiplex-model-id bracket shared by the unary
+        and streaming paths."""
+        import contextlib
+
         from .multiplex import _reset_model_id, _set_model_id
 
-        with self._count_lock:
-            self._ongoing += 1
-        token = _set_model_id(model_id)
-        try:
-            if self.user_fn is not None:
-                target = self.user_fn
-            elif method == "__call__":
-                target = self.user
-            else:
-                target = getattr(self.user, method)
+        @contextlib.contextmanager
+        def scope():
+            with self._count_lock:
+                self._ongoing += 1
+            token = _set_model_id(model_id)
+            try:
+                yield
+            finally:
+                _reset_model_id(token)
+                with self._count_lock:
+                    self._ongoing -= 1
+
+        return scope()
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             model_id: str = ""):
+        with self._request_scope(model_id):
+            target = self._resolve_target(method)
             if inspect.iscoroutinefunction(target):
                 return await target(*args, **kwargs)
             # Sync callables run off-loop: blocking user code must not stall
@@ -66,7 +84,28 @@ class ServeReplica:
             if inspect.iscoroutine(out):
                 out = await out
             return out
-        finally:
-            _reset_model_id(token)
-            with self._count_lock:
-                self._ongoing -= 1
+
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: dict, model_id: str = ""):
+        """Generator execution path: the user generator's items flow out
+        through the core streaming-returns channel one at a time
+        (reference: replica.py handle_request_streaming — the proxy and
+        handles consume an ObjectRefGenerator).  Runs as a SYNC generator
+        on the actor's thread pool, so a slow stream occupies one lane
+        while other requests keep flowing.  Called with
+        num_returns="streaming" by the handle layer."""
+        with self._request_scope(model_id):
+            target = self._resolve_target(method)
+            out = target(*args, **kwargs)
+            if inspect.isasyncgen(out):
+                raise TypeError(
+                    "streaming deployments must use sync generators "
+                    "(async generators would need the replica's event "
+                    "loop, which belongs to unary async requests)")
+            if inspect.isgenerator(out) or (
+                    hasattr(out, "__iter__")
+                    and not isinstance(out, (str, bytes, dict))):
+                for item in out:
+                    yield item
+            else:
+                yield out  # non-generator: a one-item stream
