@@ -61,6 +61,9 @@ BASELINE = {
     # 1 GiB broadcast to 50 nodes took 20.24 s => each node sustained at
     # least 1/20.24 GiB/s pulling its copy (object_store.json).
     "cross_node_pull_gib": 1.0 / 20.24,
+    # Multi-client rows (microbenchmark.json multi_client_*).
+    "multi_client_put_gib": 35.88,
+    "multi_client_tasks_async": 25166.0,
 }
 
 RESULTS = []
@@ -290,6 +293,85 @@ def bench_cross_node(quick: bool):
         cluster.shutdown()
 
 
+_MULTI_CLIENT_SCRIPT = r'''
+import json, sys, time
+import numpy as np
+import ray_tpu
+
+rank, nclients, put_reps, task_reps = map(int, sys.argv[1:5])
+ray_tpu.init()  # attaches to the parent's cluster via RT_ADDRESS
+from ray_tpu.core.context import ctx
+
+def barrier(tag):
+    ctx.client.kv_put(f"mc:{tag}:{rank}", b"1")
+    while len(ctx.client.kv_keys(f"mc:{tag}:")) < nclients:
+        time.sleep(0.005)
+
+blob = np.random.default_rng(rank).integers(
+    0, 256, 1 << 20, dtype=np.uint8).tobytes()
+barrier("puts")
+t0 = time.perf_counter()
+refs = [ray_tpu.put(blob) for _ in range(put_reps)]
+put_dt = time.perf_counter() - t0
+put_gib = put_reps / 1024.0 / put_dt
+del refs
+
+@ray_tpu.remote
+def nop():
+    return b"ok"
+
+ray_tpu.get(nop.remote(), timeout=120)  # warm a worker lease
+barrier("tasks")
+t0 = time.perf_counter()
+task_refs = [nop.remote() for _ in range(task_reps)]
+ray_tpu.get(task_refs, timeout=300)
+task_dt = time.perf_counter() - t0
+print(json.dumps({"put_gib": put_gib, "tasks_async": task_reps / task_dt}),
+      flush=True)
+ray_tpu.shutdown()
+'''
+
+
+def bench_multi_client(quick: bool):
+    """N concurrent driver processes sharing one head — the reference's
+    multi-client sections (reference: ray_perf.py multi_client_put_gigabytes
+    / n-client task submission; release_logs 2.9.3 microbenchmark.json).
+    Aggregate throughput = sum of per-client rates over the overlapped
+    (KV-barrier-aligned) window; this is the first falsifiable datapoint
+    for PERF_CEILINGS.md's single-core scaling hypothesis."""
+    import subprocess
+
+    nclients = 4
+    put_reps = 16 if quick else 64       # 1 MiB puts per client
+    task_reps = 128 if quick else 512
+    env = dict(os.environ)  # RT_ADDRESS points at the live head
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _MULTI_CLIENT_SCRIPT, str(i),
+             str(nclients), str(put_reps), str(task_reps)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(nclients)
+    ]
+    rows = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            print(f"# multi-client worker failed:\n{err[-2000:]}",
+                  file=sys.stderr)
+            continue
+        rows.append(json.loads(out.strip().splitlines()[-1]))
+    if len(rows) == nclients:
+        record("multi_client_put_gib",
+               sum(r["put_gib"] for r in rows), "GiB/s")
+        record("multi_client_tasks_async",
+               sum(r["tasks_async"] for r in rows), "tasks/s")
+    else:
+        print(f"# multi-client section incomplete: {len(rows)}/{nclients}",
+              file=sys.stderr)
+
+
 def bench_rllib(quick: bool):
     """PPO sample+update throughput (BASELINE north star: RLlib PPO
     env-steps/s; reference harness rllib/benchmarks/ppo)."""
@@ -410,6 +492,15 @@ def main():
                     rows[0]["vs_baseline"] = round(med / ref, 3)
             rows[0]["runs"] = len(rows)
             RESULTS.append(rows[0])
+
+    # Multi-client section: its own cluster so the client fleet doesn't
+    # inherit a drained worker pool.
+    time.sleep(5)
+    ray_tpu.init(num_cpus=8)
+    try:
+        bench_multi_client(args.quick)
+    finally:
+        ray_tpu.shutdown()
 
     if args.rllib:
         # Fresh cluster after the old one's worker fleet fully exits:

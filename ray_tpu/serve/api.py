@@ -196,17 +196,16 @@ class _HttpProxy:
     def __init__(self, host: str, port: int):
         import http.server
 
-        handles: Dict[str, DeploymentHandle] = {}
+        handles: Dict[tuple, DeploymentHandle] = {}
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def _stream_sse(self, h: DeploymentHandle, payload):
+            def _stream_sse(self, gen_handle: DeploymentHandle, payload):
                 """Server-sent events over a generator deployment
                 (reference: proxy.py:537-598 — the HTTP proxy streams
                 responses chunk-by-chunk as the replica produces them).
                 One `data:` frame per yielded item, flushed immediately;
                 buffering is one item in this thread, the rest in the
                 object store."""
-                gen_handle = h.options(stream=True)
                 if isinstance(payload, dict):
                     stream = gen_handle.remote(**payload)
                 elif payload is None:
@@ -245,9 +244,14 @@ class _HttpProxy:
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n)
                     payload = json.loads(body) if body else None
-                    h = handles.get(name)
+                    # Stream-mode handles are cached alongside unary ones:
+                    # a fresh handle per request would pay a controller
+                    # routing RPC and lose the p2c load counts.
+                    key = (name, want_stream)
+                    h = handles.get(key)
                     if h is None:
-                        h = handles[name] = DeploymentHandle(name)
+                        h = handles[key] = DeploymentHandle(
+                            name, stream=want_stream)
                     if want_stream:
                         self._stream_sse(h, payload)
                         return

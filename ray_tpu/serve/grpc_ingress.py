@@ -70,8 +70,10 @@ class _GrpcIngress:
             except Exception as e:  # noqa: BLE001 — mapped to a status
                 _abort_for(e, context)
 
-        def _route(request: bytes, context):
-            """Shared request parse + handle lookup for both methods."""
+        def _route(request: bytes, context, stream: bool = False):
+            """Shared request parse + handle lookup for both methods.
+            Stream-mode handles cache separately so their p2c load counts
+            persist across requests."""
             try:
                 req = json.loads(request)
                 if not isinstance(req, dict):
@@ -83,7 +85,7 @@ class _GrpcIngress:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                               f"bad request body: {e}")
             key = (name, req.get("method", "__call__"),
-                   req.get("multiplexed_model_id", ""))
+                   req.get("multiplexed_model_id", ""), stream)
             with handles_lock:
                 h = handles.get(key)
                 if h is not None:
@@ -99,7 +101,8 @@ class _GrpcIngress:
                     context.abort(grpc.StatusCode.NOT_FOUND,
                                   f"no deployment named {name!r}")
                 h = DeploymentHandle(
-                    name, key[1], multiplexed_model_id=key[2])
+                    name, key[1], multiplexed_model_id=key[2],
+                    stream=stream)
                 with handles_lock:
                     h = handles.setdefault(key, h)
                     handles.move_to_end(key)
@@ -112,9 +115,9 @@ class _GrpcIngress:
             stream is pulled item-by-item (consumer-side buffering is one
             item; the rest waits in the object store), so a slow client
             applies backpressure to this worker thread only."""
-            req, h = _route(request, context)
+            req, h = _route(request, context, stream=True)
             try:
-                stream = h.options(stream=True).remote(
+                stream = h.remote(
                     *(req.get("args") or []), **(req.get("kwargs") or {}))
                 for item in stream:
                     if not context.is_active():

@@ -64,14 +64,26 @@ class DeploymentResponseGenerator:
         self._gen = ref_gen
         self._done_cb = done_cb
 
+    def _release(self):
+        if self._done_cb is not None:
+            cb, self._done_cb = self._done_cb, None
+            cb()
+
     def __iter__(self):
         try:
             for ref in self._gen:
                 yield ray_tpu.get(ref)
         finally:
-            if self._done_cb is not None:
-                self._done_cb()
-                self._done_cb = None
+            self._release()
+
+    def __del__(self):
+        # A stream created but never iterated must still release its
+        # replica's outstanding-load count, or p2c routing skews away from
+        # that replica until the next routing-table version bump.
+        try:
+            self._release()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
 
 class DeploymentHandle:

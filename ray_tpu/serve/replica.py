@@ -96,8 +96,16 @@ class ServeReplica:
         num_returns="streaming" by the handle layer."""
         with self._request_scope(model_id):
             target = self._resolve_target(method)
+            if inspect.iscoroutinefunction(target) or \
+                    inspect.isasyncgenfunction(target):
+                raise TypeError(
+                    "streaming deployments must use sync generators "
+                    "(async callables would need the replica's event "
+                    "loop, which belongs to unary async requests)")
             out = target(*args, **kwargs)
-            if inspect.isasyncgen(out):
+            if inspect.isasyncgen(out) or inspect.iscoroutine(out):
+                if inspect.iscoroutine(out):
+                    out.close()  # never awaited by design
                 raise TypeError(
                     "streaming deployments must use sync generators "
                     "(async generators would need the replica's event "

@@ -175,6 +175,12 @@ def run(node: StepNode, *, workflow_id: str,
     def finish(n: StepNode, value: Any):
         nonlocal first_error
         if isinstance(value, StepNode):
+            if first_error is not None:
+                # A sibling already failed: launching a whole sub-DAG now
+                # would delay error propagation with fresh cluster work.
+                # The unexecuted continuation isn't checkpointed, so a
+                # resume re-runs the parent and continues normally.
+                return
             # Dynamic continuation: execute the returned sub-DAG in the
             # same workflow; ITS result is this step's durable result.
             try:
