@@ -302,9 +302,12 @@ rank, nclients, put_reps, task_reps = map(int, sys.argv[1:5])
 ray_tpu.init()  # attaches to the parent's cluster via RT_ADDRESS
 from ray_tpu.core.context import ctx
 
-def barrier(tag):
+def barrier(tag, timeout=120.0):
     ctx.client.kv_put(f"mc:{tag}:{rank}", b"1")
+    deadline = time.monotonic() + timeout
     while len(ctx.client.kv_keys(f"mc:{tag}:")) < nclients:
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"barrier {tag}: a peer never arrived")
         time.sleep(0.005)
 
 blob = np.random.default_rng(rank).integers(
@@ -356,7 +359,17 @@ def bench_multi_client(quick: bool):
     ]
     rows = []
     for p in procs:
-        out, err = p.communicate(timeout=600)
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            # A dead peer leaves survivors spinning in the KV barrier
+            # (bounded child-side too): kill, skip the section, and let
+            # the rest of the bench (and BENCH_CORE.json) proceed.
+            p.kill()
+            out, err = p.communicate()
+            print("# multi-client worker timed out (killed)",
+                  file=sys.stderr)
+            continue
         if p.returncode != 0:
             print(f"# multi-client worker failed:\n{err[-2000:]}",
                   file=sys.stderr)

@@ -1043,9 +1043,13 @@ class Dataset:
         execution/backpressure_policy/ + resource_manager.py):
         - task count: never more than ``execution_window`` parts in flight;
         - bytes: the window adapts down to keep (in-flight blocks x learned
-          mean block size) under ``DataContext.max_in_flight_bytes``, with
-          block sizes learned from sealed objects via the head's object
-          table (no fetches).
+          block size) under ``DataContext.max_in_flight_bytes``.  Sizing
+          uses a HIGH PERCENTILE (p90) of recently observed block sizes,
+          not the mean — a mixed dataset (small metadata blocks, then
+          large image blocks) would overshoot the budget several-fold
+          while a mean caught up.  Sizes come from sealed objects via the
+          head's object table (no fetches), probed every submission until
+          the sample is warm.
         Chains containing an ActorPoolStrategy op route to that pool's
         actors instead of stateless tasks."""
         cfg = DataContext.get_current()
@@ -1056,16 +1060,23 @@ class Dataset:
                  "effective_window_min": max_win}
         cfg.last_execution_stats = stats
         pools = _PoolManager()
-        sized: Dict[Any, int] = {}
+        seen_ids: set = set()
+        recent_sizes: deque = deque(maxlen=64)  # sliding sample window
+        warm_after = 8
         try:
             pending: deque = deque()
             for src, ops in self._parts:
                 eff = max_win
-                if budget and sized:
-                    avg = sum(sized.values()) / len(sized)
-                    if avg > 0:
+                if budget and recent_sizes:
+                    ordered = sorted(recent_sizes)
+                    # Nearest-rank p90 (rounds toward the max for small
+                    # samples — conservative means under-budget, never
+                    # over).
+                    p90 = ordered[min(len(ordered) - 1,
+                                      int(0.9 * len(ordered)))]
+                    if p90 > 0:
                         eff = max(min_win,
-                                  min(max_win, int(budget // avg)))
+                                  min(max_win, int(budget // p90)))
                 stats["effective_window_min"] = min(
                     stats["effective_window_min"], eff)
                 while len(pending) >= eff:
@@ -1081,15 +1092,20 @@ class Dataset:
                 stats["submitted"] += 1
                 stats["peak_in_flight"] = max(stats["peak_in_flight"],
                                               len(pending))
-                if budget and stats["submitted"] % 4 == 0:
+                # Probe every submission until the sample is warm (a cold
+                # mean/percentile is what lets mixed sizes overshoot),
+                # then every 4th.
+                if budget and (len(recent_sizes) < warm_after
+                               or stats["submitted"] % 4 == 0):
                     probe = [r for r in pending
-                             if r.binary() not in sized]
+                             if r.binary() not in seen_ids]
                     if probe:
                         # Key by id bytes, NOT the ref: holding refs here
                         # would pin every probed block in the store.
                         for r, sz in zip(probe, _object_sizes(probe)):
                             if sz:
-                                sized[r.binary()] = sz
+                                seen_ids.add(r.binary())
+                                recent_sizes.append(sz)
             while pending:
                 yield pending.popleft()
         finally:

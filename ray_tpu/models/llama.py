@@ -54,6 +54,17 @@ class LlamaConfig:
     remat_policy: str = "full"
     # sp_axis set -> use ring attention over that mesh axis inside shard_map
     sp_ring: bool = False
+    # Flash-attention tile shapes.  The kernel auto-shrinks when a block
+    # exceeds (or doesn't divide) the sequence, so these are CAPS, not
+    # exact tiles.  block_q=1024 measured ~+1pp MFU at seq=2048 on v5e
+    # (fewer grid launches per head, same VMEM residency); 512 is the
+    # safe default across shapes.
+    flash_block_q: int = 512
+    flash_block_k: int = 512
+    # Sequence-chunk size for the vocab-projection loss scan (see
+    # llama_loss): larger chunks feed the [B*chunk, d]@[d, vocab] matmul
+    # more rows per launch, at (B * chunk * vocab * 4B) logits memory.
+    loss_chunk: int = 256
 
     @property
     def head_dim(self) -> int:
@@ -189,7 +200,9 @@ def _attention(config: LlamaConfig, x, layer, cos, sin, lora_layer=None):
     else:
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        out = flash_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True,
+                              block_q=config.flash_block_q,
+                              block_k=config.flash_block_k)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, d)
     return out @ a["wo"]
 
@@ -285,7 +298,7 @@ def llama_loss(
         logits = (h_c @ w).astype(jnp.float32)
         return masked_nll(logits, tgt_c, ignore_index)
 
-    chunk = 256
+    chunk = config.loss_chunk
     if S % chunk != 0:
         total, count = chunk_nll(hidden, targets)
         return total / jnp.maximum(count, 1)

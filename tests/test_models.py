@@ -330,3 +330,44 @@ class TestPipelineParallel:
         # Both stages' attention weights received gradient signal.
         gq = np.asarray(grads["layers"]["attn"]["wq"])
         assert np.abs(gq[0]).max() > 0 and np.abs(gq[1]).max() > 0
+
+
+class TestGradAccum:
+    def test_grad_accum_matches_full_batch(self):
+        """grad_accum=2 inside one jitted step: the accumulated mean
+        gradient must match the full-batch gradient (equal microbatches:
+        mean of per-micro means == full mean), so parameters after one
+        update agree within bf16/f32 accumulation tolerance."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import (
+            LlamaConfig, TrainState, llama_init, llama_loss,
+        )
+        from ray_tpu.models.train_state import (
+            default_optimizer, make_train_step,
+        )
+
+        cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                  cfg.vocab_size)
+        batch = {"tokens": toks, "targets": jnp.roll(toks, -1, axis=1)}
+        tx = default_optimizer(lr=1e-3)
+        loss_fn = lambda p, b: llama_loss(cfg, p, b["tokens"], b["targets"])
+
+        s_full = TrainState.create(jax.tree.map(jnp.copy, params), tx)
+        s_acc = TrainState.create(jax.tree.map(jnp.copy, params), tx)
+        step_full = make_train_step(loss_fn, tx)
+        step_acc = make_train_step(loss_fn, tx, grad_accum=2)
+        s_full, m_full = step_full(s_full, batch)
+        s_acc, m_acc = step_acc(s_acc, batch)
+        assert float(m_acc["loss"]) == pytest.approx(
+            float(m_full["loss"]), rel=1e-5)
+        assert float(m_acc["grad_norm"]) == pytest.approx(
+            float(m_full["grad_norm"]), rel=1e-4)
+        for a, b in zip(jax.tree.leaves(s_acc.params),
+                        jax.tree.leaves(s_full.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=2e-4)

@@ -331,7 +331,7 @@ def test_multi_agent_ppo_two_policies_route_and_learn(rt):
     best = 0.0
     result = {}
     try:
-        for _ in range(40):
+        for _ in range(70):
             result = algo.train()
             if not np.isnan(result["episode_return_mean"]):
                 best = max(best, result["episode_return_mean"])
