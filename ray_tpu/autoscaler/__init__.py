@@ -114,7 +114,13 @@ class Autoscaler:
         poll_interval_s: float = 1.0,
         upscaling_speed: int = 1,
     ):
+        from .instance_manager import InstanceManager
+
         self.provider = provider
+        # All fleet mutations go through the instance manager so every
+        # node has an auditable lifecycle record (the v2 shape; reference:
+        # autoscaler/v2/instance_manager/instance_manager.py:29).
+        self.instance_manager = InstanceManager(provider)
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
         self.idle_timeout_s = idle_timeout_s
@@ -205,9 +211,9 @@ class Autoscaler:
             # Never drain while demand exists — at max_nodes that would
             # churn create/terminate forever.
             if len(nodes) < self.max_nodes:
-                for _ in range(min(self.upscaling_speed,
-                                   self.max_nodes - len(nodes))):
-                    self.provider.create_node()
+                self.instance_manager.update(
+                    launch=min(self.upscaling_speed,
+                               self.max_nodes - len(nodes)))
             return
         now = time.monotonic()
         for handle in nodes:
@@ -222,7 +228,18 @@ class Autoscaler:
                 continue
             first_idle = self._idle_since.setdefault(key, now)
             if now - first_idle >= self.idle_timeout_s:
-                self.provider.terminate_node(handle)
+                from .instance_manager import ALLOCATED, RUNNING, TERMINATING
+
+                inst = self.instance_manager.instance_of_handle(handle)
+                if inst is not None and inst.status in (
+                        ALLOCATED, RUNNING, TERMINATING):
+                    self.instance_manager.update(
+                        terminate=[inst.instance_id])
+                else:
+                    # Outside the manager (pre-existing provider state) or
+                    # a terminal record whose node the provider still
+                    # lists: terminate directly so nothing zombies.
+                    self.provider.terminate_node(handle)
                 self._idle_since.pop(key, None)
 
     # -- lifecycle -----------------------------------------------------------

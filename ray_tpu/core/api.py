@@ -414,8 +414,8 @@ def _process_runtime_env(renv: Optional[dict], cache: Optional[dict] = None):
     shutdown()+init() cycle re-populates the new cluster's KV (reference:
     _private/runtime_env/working_dir.py URI-cached packages;
     runtime_env/py_modules.py ships import roots the same way)."""
-    if not renv or ("working_dir" not in renv and "py_modules" not in renv
-                    and "pip" not in renv):
+    if not renv or not any(k in renv for k in
+                           ("working_dir", "py_modules", "pip", "conda")):
         return renv
     cache = cache if cache is not None else {}
     out = dict(renv)
@@ -520,6 +520,27 @@ def _process_runtime_env(renv: Optional[dict], cache: Optional[dict] = None):
         out.pop("pip")
         out["pip_env"] = {k: v for k, v in pip_env.items()
                           if k != "_wheel_blobs"}
+    if "conda" in renv:
+        # Conda envs (reference: _private/runtime_env/conda.py:260 —
+        # content-addressed env creation from an environment dict, or
+        # activation of a pre-existing named env).  The worker shells out
+        # to the `conda` executable; clusters without conda fail fast
+        # with a clear error at task setup.
+        spec = renv["conda"]
+        if isinstance(spec, dict):
+            import json as _json
+
+            canon = _json.dumps(spec, sort_keys=True)
+            env_hash = hashlib.sha256(canon.encode()).hexdigest()[:16]
+            conda_env = {"hash": env_hash, "spec": canon}
+        elif isinstance(spec, str):
+            # A named env / prefix path that must already exist.
+            conda_env = {"name": spec}
+        else:
+            raise TypeError("runtime_env['conda'] must be an environment "
+                            "dict or an existing env name/prefix")
+        out.pop("conda")
+        out["conda_env"] = conda_env
     return out
 
 

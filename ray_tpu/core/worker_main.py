@@ -325,6 +325,69 @@ class Worker:
             sys.path.insert(0, site)
         return site, venv_dir
 
+    def _setup_conda_env(self, conda_env: dict):
+        """Create (once, content-addressed) and activate a conda env
+        (reference: _private/runtime_env/conda.py:260 — env created from a
+        spec dict via the conda CLI, cached by content hash; named envs
+        activate in place).  Activation mirrors the pip path: the env's
+        site-packages joins sys.path (module eviction on teardown) and
+        bin/ prepends PATH for subprocesses; the worker's interpreter is
+        NOT swapped — a different-python conda env carries its packages,
+        not its binary (documented limitation; the reference execs the
+        env's python for that).  Returns (site_dir_or_None, prefix)."""
+        import fcntl
+        import glob as _glob
+        import shutil
+        import subprocess
+
+        conda = shutil.which("conda")
+        if conda is None:
+            raise RuntimeError(
+                "runtime_env['conda'] requested but no `conda` executable "
+                "is on PATH for the worker")
+        if "name" in conda_env:
+            name = conda_env["name"]
+            if os.path.isdir(name):
+                prefix = name
+            else:
+                root = subprocess.run(
+                    [conda, "info", "--base"], capture_output=True,
+                    text=True, timeout=60,
+                ).stdout.strip()
+                prefix = os.path.join(root, "envs", name)
+            if not os.path.isdir(prefix):
+                raise RuntimeError(f"conda env {name!r} not found")
+        else:
+            env_hash = conda_env["hash"]
+            root = os.path.join("/tmp/ray_tpu_envs", f"conda-{env_hash}")
+            prefix = os.path.join(root, "env")
+            ready = os.path.join(root, "READY")
+            if not os.path.exists(ready):
+                os.makedirs(root, exist_ok=True)
+                with open(os.path.join(root, ".lock"), "w") as lock:
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                    if not os.path.exists(ready):
+                        spec_path = os.path.join(root, "environment.json")
+                        with open(spec_path, "w") as f:
+                            f.write(conda_env["spec"])
+                        proc = subprocess.run(
+                            [conda, "env", "create", "-p", prefix,
+                             "-f", spec_path, "--yes"],
+                            capture_output=True, text=True, timeout=1800,
+                        )
+                        if proc.returncode != 0:
+                            raise RuntimeError(
+                                "conda env create failed:\n"
+                                f"{proc.stderr[-2000:]}")
+                        with open(ready, "w") as f:
+                            f.write("ok")
+        sites = _glob.glob(os.path.join(
+            prefix, "lib", "python*", "site-packages"))
+        site = sites[0] if sites else None
+        if site is not None and site not in sys.path:
+            sys.path.insert(0, site)
+        return site, prefix
+
     def _setup_working_dir(self, key: str):
         """Extract a content-addressed working_dir archive (cached per key)
         and enter it (reference: runtime_env/working_dir.py — URI-cached
@@ -466,6 +529,16 @@ class Worker:
                 vbin = os.path.join(venv_dir, "bin")
                 for k, v in (("VIRTUAL_ENV", venv_dir),
                              ("PATH", vbin + os.pathsep
+                              + os.environ.get("PATH", ""))):
+                    saved_env.setdefault(k, os.environ.get(k))
+                    os.environ[k] = v
+            if renv.get("conda_env"):
+                site, prefix = self._setup_conda_env(renv["conda_env"])
+                if site is not None:
+                    pymod_roots.append(site)
+                cbin = os.path.join(prefix, "bin")
+                for k, v in (("CONDA_PREFIX", prefix),
+                             ("PATH", cbin + os.pathsep
                               + os.environ.get("PATH", ""))):
                     saved_env.setdefault(k, os.environ.get(k))
                     os.environ[k] = v

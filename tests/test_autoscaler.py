@@ -167,3 +167,54 @@ def test_unscalable_demand_does_not_pin_cluster(rt):
         assert provider.non_terminated_nodes() == []  # never scaled for it
     finally:
         ray_tpu.remove_placement_group(pg)
+
+
+def test_instance_manager_lifecycle(rt):
+    """The v2 shape: every node the reconciler launches/terminates gets an
+    Instance with a validated status history in the versioned storage
+    (reference: autoscaler/v2 instance_manager.py + instance_storage.py)."""
+    from ray_tpu.autoscaler.instance_manager import (
+        ALLOCATION_FAILED, RUNNING, TERMINATED, Instance, InstanceManager,
+        InstanceStorage,
+    )
+
+    provider = LocalNodeProvider(num_cpus=1)
+    mgr = InstanceManager(provider)
+    (iid,) = mgr.update(launch=1)
+    assert set(mgr.running()) == {iid}
+    state = {s["instance_id"]: s for s in mgr.get_state()}
+    assert [h["status"] for h in state[iid]["history"]] == [
+        "QUEUED", "REQUESTED", "ALLOCATED", "RAY_RUNNING"]
+    assert len(state[iid]["node_ids"]) == 1
+
+    v_before = mgr.storage.version
+    mgr.update(terminate=[iid])
+    instances, version = mgr.storage.get_instances()
+    assert instances[iid].status == TERMINATED
+    assert version > v_before  # every batch bumps the store version
+    assert provider.non_terminated_nodes() == []
+
+    # Provider failure -> ALLOCATION_FAILED in the table, not an exception.
+    class Boom:
+        def create_node(self):
+            raise RuntimeError("quota")
+
+        def node_ids_of(self, h):
+            return []
+
+    mgr2 = InstanceManager(Boom())
+    assert mgr2.update(launch=1) == []
+    instances, _ = mgr2.storage.get_instances()
+    assert [i.status for i in instances.values()] == [ALLOCATION_FAILED]
+
+    # Optimistic concurrency: a stale expected_version is rejected.
+    store = InstanceStorage()
+    assert store.batch_update([Instance("a")], expected_version=0)
+    assert not store.batch_update([Instance("b")], expected_version=0)
+    assert store.batch_update([Instance("b")],
+                              expected_version=store.version)
+
+    # Invalid transitions are bugs, not silent corruption.
+    inst = Instance("x")
+    with pytest.raises(ValueError, match="invalid instance transition"):
+        mgr._transition(inst, "RAY_RUNNING")

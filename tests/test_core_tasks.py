@@ -310,3 +310,99 @@ def test_runtime_env_pip_isolation(rt, tmp_path):
         return isopkg.VALUE
 
     assert rt.get(inside2.remote(), timeout=120) == 77
+
+
+def test_runtime_env_conda(tmp_path, monkeypatch):
+    """runtime_env={'conda': {...}}: the worker creates a content-addressed
+    env through the `conda` CLI and activates it (site-packages on
+    sys.path, bin/ on PATH, CONDA_PREFIX set).  A fake conda executable
+    records the invocation — the same dry-run pattern as the GCE provider
+    (reference: _private/runtime_env/conda.py:260)."""
+    import json
+    import stat
+    import sys as _sys
+
+    # Fake conda: `conda env create -p <prefix> -f <spec>` materializes a
+    # site-packages with a marker module carrying the spec's dependency.
+    fake_bin = tmp_path / "bin"
+    fake_bin.mkdir()
+    log = tmp_path / "conda_calls.log"
+    site_rel = f"lib/python{_sys.version_info[0]}.{_sys.version_info[1]}/site-packages"
+    conda_sh = fake_bin / "conda"
+    conda_sh.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+    prefix=""; spec=""
+    while [ $# -gt 0 ]; do
+        case "$1" in
+            -p) prefix="$2"; shift ;;
+            -f) spec="$2"; shift ;;
+        esac
+        shift
+    done
+    mkdir -p "$prefix/bin" "$prefix/{site_rel}"
+    cp "$spec" "$prefix/{site_rel}/spec.json"
+    printf 'SPEC_PATH = %s\\n' "'$prefix/{site_rel}/spec.json'" \
+        > "$prefix/{site_rel}/conda_marker.py"
+fi
+exit 0
+""")
+    conda_sh.chmod(conda_sh.stat().st_mode | stat.S_IEXEC)
+    import os as _os
+    monkeypatch.setenv("PATH",
+                       str(fake_bin) + ":" + _os.environ.get("PATH", ""))
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)  # AFTER the PATH patch: workers inherit it
+    try:
+        # tmp_path in the spec keeps the content hash unique per run: the
+        # /tmp/ray_tpu_envs cache would otherwise satisfy the second test
+        # run without ever invoking the fake conda.
+        spec = {"name": f"test-env-{tmp_path.name}",
+                "dependencies": ["numpy=1.26"]}
+
+        @ray_tpu.remote(runtime_env={"conda": spec})
+        def inside():
+            import json as _json
+            import os as _os
+
+            import conda_marker
+
+            with open(conda_marker.SPEC_PATH) as f:
+                loaded = _json.load(f)
+            return loaded, _os.environ.get("CONDA_PREFIX", "")
+
+        loaded, prefix = ray_tpu.get(inside.remote(), timeout=60)
+        assert loaded == spec
+        assert "/tmp/ray_tpu_envs/conda-" in prefix
+        calls = log.read_text().strip().splitlines()
+        assert any("env create" in c for c in calls)
+
+        # Same spec again: content-addressed reuse, no second create.
+        ray_tpu.get(inside.remote(), timeout=60)
+        creates = [c for c in log.read_text().splitlines()
+                   if "env create" in c]
+        assert len(creates) == 1
+
+        # Isolation: pooled workers without the env don't see the marker.
+        @ray_tpu.remote
+        def outside():
+            try:
+                import conda_marker  # noqa: F401
+                return True
+            except ImportError:
+                return False
+
+        assert not any(ray_tpu.get([outside.remote() for _ in range(4)],
+                                   timeout=60))
+
+        # A named env that doesn't exist fails with a clear error.
+        @ray_tpu.remote(runtime_env={"conda": "no-such-env"})
+        def missing():
+            return 1
+
+        with pytest.raises(exceptions.RayTpuError, match="not found"):
+            ray_tpu.get(missing.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
