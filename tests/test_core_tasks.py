@@ -355,11 +355,15 @@ exit 0
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2)  # AFTER the PATH patch: workers inherit it
+    prefix = ""  # set on success; finally's cleanup guards on it
     try:
-        # tmp_path in the spec keeps the content hash unique per run: the
-        # /tmp/ray_tpu_envs cache would otherwise satisfy the second test
-        # run without ever invoking the fake conda.
-        spec = {"name": f"test-env-{tmp_path.name}",
+        # A unique token keeps the content hash fresh per run: the
+        # /tmp/ray_tpu_envs cache would otherwise satisfy later runs
+        # without ever invoking the fake conda (tmp_path.name repeats
+        # across pytest sessions — uuid4 does not).
+        import uuid as _uuid
+
+        spec = {"name": f"test-env-{_uuid.uuid4().hex[:12]}",
                 "dependencies": ["numpy=1.26"]}
 
         @ray_tpu.remote(runtime_env={"conda": spec})
@@ -406,3 +410,9 @@ exit 0
             ray_tpu.get(missing.remote(), timeout=60)
     finally:
         ray_tpu.shutdown()
+        # The uuid-fresh spec creates a new cache dir every run; reap it
+        # so /tmp/ray_tpu_envs doesn't grow across sessions.
+        import shutil
+
+        if "/tmp/ray_tpu_envs/conda-" in prefix:
+            shutil.rmtree(_os.path.dirname(prefix), ignore_errors=True)
