@@ -18,6 +18,7 @@ from .search import (
     BasicVariantGenerator,
     ConcurrencyLimiter,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -41,6 +42,7 @@ __all__ = [
     "Tuner", "TuneConfig", "TuneError", "TuneInterrupted",
     "Result", "ResultGrid", "report", "get_trial_dir", "get_checkpoint",
     "grid_search", "choice", "uniform", "loguniform", "randint",
+    "TPESearcher",
     "sample_from", "ASHAScheduler", "HyperBandScheduler", "FIFOScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining", "Searcher", "BasicVariantGenerator",

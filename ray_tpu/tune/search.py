@@ -206,3 +206,138 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id: str, result=None):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator search (Bergstra et al. 2011 —
+    the algorithm behind HyperOpt; reference: tune/search/hyperopt/
+    hyperopt_search.py wraps the same method).  Native implementation over
+    this module's Domain types, so no external dependency.
+
+    After ``n_initial`` random trials, completed observations split at the
+    ``gamma`` quantile into good/bad sets; per dimension, candidates drawn
+    from a kernel density around the GOOD observations are ranked by the
+    density ratio l(x)/g(x) and the best of ``n_candidates`` is suggested
+    — search concentrates where good results cluster while the bad-set
+    density keeps it exploring."""
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "min", n_initial: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: int = 0):
+        assert mode in ("min", "max")
+        import numpy as np
+
+        self.space = dict(param_space)
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.py_rng = random.Random(seed)      # Domain.sample's rng type
+        self.rng = np.random.default_rng(seed)  # KDE math
+        self._np = np
+        self._suggested: Dict[str, Dict[str, Any]] = {}
+        self._history: list = []  # (config, score)
+        for key, dom in self.space.items():
+            if not isinstance(dom, (Categorical, Uniform, LogUniform,
+                                    RandInt)):
+                raise TypeError(
+                    f"TPESearcher supports Categorical/Uniform/LogUniform/"
+                    f"RandInt domains; {key!r} is {type(dom).__name__}")
+
+    # -- sampling helpers ----------------------------------------------------
+
+    def _random_config(self) -> Dict[str, Any]:
+        return {k: d.sample(self.py_rng) for k, d in self.space.items()}
+
+    def _bounds(self, dom):
+        """Numeric-space (lo, hi) for a dimension."""
+        if isinstance(dom, LogUniform):
+            return dom.log_lower, dom.log_upper
+        if isinstance(dom, RandInt):
+            return float(dom.lower), float(dom.upper - 1)
+        return float(dom.lower), float(dom.upper)
+
+    def _numeric_repr(self, dom, value):
+        if isinstance(dom, LogUniform):
+            return float(self._np.log(value))
+        return float(value)
+
+    def _from_numeric(self, dom, x):
+        np = self._np
+        lo, hi = self._bounds(dom)
+        x = float(np.clip(x, lo, hi))
+        if isinstance(dom, LogUniform):
+            return float(np.exp(x))
+        if isinstance(dom, RandInt):
+            return int(round(x))
+        return x
+
+    def _propose_dim(self, dom, good, bad):
+        """Best-of-candidates by the l/g density ratio for one dimension."""
+        np = self._np
+        if isinstance(dom, Categorical):
+            cats = list(dom.categories)
+
+            def weights(obs):
+                w = np.ones(len(cats))  # +1 smoothing
+                for v in obs:
+                    w[cats.index(v)] += 1
+                return w / w.sum()
+
+            wl, wg = weights(good), weights(bad)
+            idx = self.rng.choice(len(cats), size=self.n_candidates, p=wl)
+            best = idx[int(np.argmax(wl[idx] / wg[idx]))]
+            return cats[int(best)]
+        g = np.array([self._numeric_repr(dom, v) for v in good])
+        b = np.array([self._numeric_repr(dom, v) for v in bad])
+        lo, hi = self._bounds(dom)
+        span = max(hi - lo, 1e-12)
+        bw_g = max(span / max(len(g), 1), span * 0.05)
+        bw_b = max(span / max(len(b), 1), span * 0.05)
+
+        def density(x, pts, bw):
+            if len(pts) == 0:
+                return np.full_like(x, 1.0 / span)
+            d = (x[:, None] - pts[None, :]) / bw
+            return np.exp(-0.5 * d * d).sum(axis=1) / (len(pts) * bw) \
+                + 1e-12
+
+        centers = self.rng.choice(g, size=self.n_candidates)
+        cand = np.clip(centers + self.rng.normal(0, bw_g,
+                                                 self.n_candidates),
+                       lo, hi)
+        ratio = density(cand, g, bw_g) / density(cand, b, bw_b)
+        return self._from_numeric(dom, float(cand[int(np.argmax(ratio))]))
+
+    # -- Searcher protocol ---------------------------------------------------
+
+    def suggest(self, trial_id: str):
+        if len(self._history) < self.n_initial:
+            cfg = self._random_config()
+        else:
+            np = self._np
+            scores = np.array([s for _, s in self._history])
+            if self.mode == "max":
+                scores = -scores
+            cut = np.quantile(scores, self.gamma)
+            configs = [c for c, _ in self._history]
+            good = [c for c, s in zip(configs, scores) if s <= cut]
+            bad = [c for c, s in zip(configs, scores) if s > cut]
+            if not good or not bad:
+                cfg = self._random_config()
+            else:
+                cfg = {
+                    k: self._propose_dim(dom, [c[k] for c in good],
+                                         [c[k] for c in bad])
+                    for k, dom in self.space.items()
+                }
+        self._suggested[trial_id] = cfg
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id: str, result=None):
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or result is None or self.metric not in result:
+            return
+        self._history.append((cfg, float(result[self.metric])))

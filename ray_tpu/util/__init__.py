@@ -1,5 +1,8 @@
-"""ray_tpu.util: metrics, state helpers (reference: ray.util)."""
+"""ray_tpu.util: metrics, actor pools, queues, state helpers
+(reference: ray.util)."""
 
 from . import metrics
+from .actor_pool import ActorPool
+from .queue import Empty, Full, Queue
 
-__all__ = ["metrics"]
+__all__ = ["metrics", "ActorPool", "Queue", "Empty", "Full"]

@@ -1,0 +1,141 @@
+"""ray_tpu.util tests: ActorPool scheduling, distributed Queue semantics.
+
+Reference analog: python/ray/tests/test_actor_pool.py, test_queue.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Doubler:
+    def __init__(self):
+        import os
+
+        self.pid = os.getpid()
+
+    def work(self, x, delay=0.0):
+        time.sleep(delay)
+        return x * 2
+
+    def whoami(self, x):
+        return self.pid
+
+
+def test_actor_pool_map_ordered_and_unordered(rt):
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    # Ordered map keeps submission order even with skewed task times.
+    vals = list(pool.map(
+        lambda a, v: a.work.remote(v, delay=0.3 if v == 0 else 0.0),
+        range(6)))
+    assert vals == [0, 2, 4, 6, 8, 10]
+    # Unordered yields fast results first.
+    out = list(pool.map_unordered(
+        lambda a, v: a.work.remote(v, delay=0.5 if v == 0 else 0.0),
+        range(4)))
+    assert sorted(out) == [0, 2, 4, 6]
+    assert out[-1] == 0  # the slow item finished last
+
+    # The work actually spread over multiple actors.
+    pids = set(pool.map(lambda a, v: a.whoami.remote(v), range(9)))
+    assert len(pids) >= 2
+
+
+def test_actor_pool_submit_get_next(rt):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    assert pool.has_free()
+    pool.submit(lambda a, v: a.work.remote(v), 10)
+    pool.submit(lambda a, v: a.work.remote(v), 11)
+    assert not pool.has_free()
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 20
+    assert pool.get_next(timeout=30) == 22
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_queue_blocking_and_batches(rt):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full() and q.qsize() == 2
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+    # Blocking get unblocks when a producer (another thread) puts.
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(q.get(timeout=10)), daemon=True)
+    t.start()
+    time.sleep(0.3)
+    q.put("late")
+    t.join(timeout=10)
+    assert got == ["late"]
+
+    # Batches.
+    q2 = Queue(maxsize=3)
+    with pytest.raises(Full):
+        q2.put_nowait_batch([1, 2, 3, 4])
+    assert q2.get_nowait_batch(10) == [1, 2, 3]
+    q2.shutdown()
+    q.shutdown()
+
+
+def test_queue_shared_across_tasks(rt):
+    """The handle pickles: producer and consumer tasks share one queue."""
+    q = Queue(maxsize=16)
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return sorted(q.get(timeout=30) for _ in range(n))
+
+    p = producer.remote(q, 8)
+    c = consumer.remote(q, 8)
+    assert ray_tpu.get(c, timeout=60) == list(range(8))
+    assert ray_tpu.get(p, timeout=60) == 8
+    q.shutdown()
+
+
+def test_actor_pool_mixed_ordered_unordered(rt):
+    """Interleaving unordered and ordered gets mid-stream must not strand
+    results: ordered gets skip indices the unordered gets already
+    returned (reference ActorPool supports mixing)."""
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    pool.submit(lambda a, v: a.work.remote(v), 0)
+    pool.submit(lambda a, v: a.work.remote(v), 1)
+    first = pool.get_next_unordered(timeout=30)
+    assert first in (0, 2)
+    assert pool.has_next()
+    second = pool.get_next(timeout=30)  # skips the consumed index
+    assert {first, second} == {0, 2}
+    assert not pool.has_next()
+    # Counters reset: a fresh ordered map starts clean.
+    assert list(pool.map(lambda a, v: a.work.remote(v), [5, 6])) == [10, 12]
