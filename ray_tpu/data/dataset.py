@@ -345,6 +345,106 @@ def _shuffle_merge(refs: List[Any], seed) -> Block:
 
 
 @ray_tpu.remote
+def _hash_partition(block: Block, key: str, n_out: int) -> List[Block]:
+    """Route each row to hash(key) % n_out (submitted with
+    num_returns=n_out) — stage 1 of the join exchange."""
+    keys = block.to_numpy()[key]
+    # Stable content hash per value (numpy's hash of scalars is fine for
+    # ints/strings via python hash, but hash() of str is salted per
+    # process — workers differ!  Use a deterministic digest instead.)
+    import zlib
+
+    which = np.fromiter(
+        (zlib.crc32(repr(v.item() if hasattr(v, "item") else v).encode())
+         % n_out for v in keys),
+        dtype=np.int64, count=len(keys),
+    )
+    return [block.take_rows(np.flatnonzero(which == j))
+            for j in builtins.range(n_out)]
+
+
+@ray_tpu.remote
+def _np_schema(refs: List[Any]) -> Dict[str, str]:
+    """Raw numpy dtype strings (np.dtype-parseable) of the first non-empty
+    block — the join exchange ships these so empty partitions keep the
+    full column set."""
+    for r in refs:
+        b = ray_tpu.get(r)
+        if b.num_rows:
+            return {k: v.dtype.str for k, v in b.to_numpy().items()}
+    return {}
+
+
+@ray_tpu.remote
+def _hash_join_partition(left_refs: List[Any], right_refs: List[Any],
+                         on: str, how: str, suffix: str,
+                         lschema: Dict[str, str],
+                         rschema: Dict[str, str]) -> Block:
+    """Stage 2: join ONE hash partition.  Build an index over the right
+    side's keys, probe with the left side's (classic hash join; both
+    sides of a partition share hash(key), so the join is complete).
+    ``lschema``/``rschema`` carry the full column sets so partitions with
+    an empty side still emit schema-consistent blocks (a left join whose
+    partition has no right rows must still create the right columns)."""
+    left = Block.concat([ray_tpu.get(r) for r in left_refs])
+    right = Block.concat([ray_tpu.get(r) for r in right_refs])
+    lcols = left.to_numpy()
+    rcols = right.to_numpy()
+    for name, dt in lschema.items():
+        if name not in lcols:
+            lcols[name] = np.empty(0, np.dtype(dt))
+    for name, dt in rschema.items():
+        if name not in rcols:
+            rcols[name] = np.empty(0, np.dtype(dt))
+    rkeys = rcols.get(on, np.array([]))
+    index: dict = {}
+    for i in builtins.range(len(rkeys)):
+        k = rkeys[i].item() if hasattr(rkeys[i], "item") else rkeys[i]
+        index.setdefault(k, []).append(i)
+    lkeys = lcols.get(on, np.array([]))
+    li: List[int] = []
+    ri: List[int] = []
+    unmatched: List[int] = []
+    for i in builtins.range(len(lkeys)):
+        k = lkeys[i].item() if hasattr(lkeys[i], "item") else lkeys[i]
+        rows = index.get(k)
+        if rows:
+            li.extend([i] * len(rows))
+            ri.extend(rows)
+        elif how == "left":
+            unmatched.append(i)
+    out: Dict[str, np.ndarray] = {}
+    li_a, ri_a = np.asarray(li, np.int64), np.asarray(ri, np.int64)
+    for name, col in lcols.items():
+        out[name] = col[li_a]
+    for name, col in rcols.items():
+        if name == on:
+            continue
+        out_name = name + suffix if name in lcols else name
+        out[out_name] = col[ri_a]
+    if how == "left" and unmatched:
+        um = np.asarray(unmatched, np.int64)
+        for name, col in lcols.items():
+            out[name] = np.concatenate([out[name], col[um]])
+        n_um = len(um)
+        for name, col in rcols.items():
+            if name == on:
+                continue
+            out_name = name + suffix if name in lcols else name
+            matched = out[out_name]
+            if np.issubdtype(col.dtype, np.number):
+                # Unmatched rows get NaN; integer columns upcast (the
+                # usual null-introducing join semantics).
+                matched = matched.astype(np.float64, copy=False)
+                fill = np.full(n_um, np.nan)
+            else:
+                matched = matched.astype(object, copy=False)
+                fill = np.full(n_um, None, object)
+            out[out_name] = np.concatenate([matched, fill])
+    return Block.from_batch(out) if out else Block({})
+
+
+@ray_tpu.remote
 def _gather_spans(spans: List[tuple]) -> Block:
     """Concatenate row spans [(block_ref, lo, hi), ...] into one block.
     Workers pull the referenced blocks (cross-node via the object plane)."""
@@ -848,6 +948,54 @@ class Dataset:
             bucket = [pl[j] for pl in part_lists]
             parts.append((_shuffle_merge.remote(bucket, (base, 1, j)), []))
         return Dataset(parts, total_rows=sum(counts))
+
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             *, num_partitions: Optional[int] = None,
+             suffix: str = "_r") -> "Dataset":
+        """Key-based join as a hash-partition/merge exchange (reference:
+        Dataset.join — distributed hash join; the exchange shape matches
+        planner/exchange/: stage 1 hash-routes each input block's rows
+        with a num_returns fan-out, stage 2 joins one partition per task,
+        so no worker ever holds either full dataset).
+
+        ``how``: "inner" or "left".  Right-side columns colliding with
+        left names (other than the key) get ``suffix``.  Key hashing uses
+        a content digest, not Python hash() (which is salted per worker
+        process)."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"unsupported join how={how!r}")
+        left_refs = list(self._iter_block_refs())
+        right_refs = list(other._iter_block_refs())
+        n_out = num_partitions or max(len(left_refs), len(right_refs), 1)
+        lschema_ref = _np_schema.remote(left_refs)
+        rschema_ref = _np_schema.remote(right_refs)
+
+        def scatter(refs):
+            if n_out == 1:
+                return [list(refs)]
+            lists = [
+                _hash_partition.options(num_returns=n_out).remote(
+                    r, on, n_out)
+                for r in refs
+            ]
+            return [[pl[j] for pl in lists]
+                    for j in builtins.range(n_out)]
+
+        left_parts = scatter(left_refs)
+        right_parts = scatter(right_refs)
+        lschema, rschema = ray_tpu.get([lschema_ref, rschema_ref])
+        parts = [
+            (_hash_join_partition.remote(
+                left_parts[j], right_parts[j], on, how, suffix,
+                lschema, rschema), [])
+            for j in builtins.range(n_out)
+        ]
+        return Dataset(
+            parts,
+            logical=self._logical.appended(LogicalOp(
+                "exchange", f"HashJoin[{how}]",
+                {"on": on, "partitions": n_out})),
+        )
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         """Total order by one column via a sample -> range-partition ->

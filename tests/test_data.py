@@ -626,3 +626,53 @@ def test_limit_pushdown_stops_reading_files(rt, tmp_path):
         limit=7)
     block = task()
     assert block.num_rows == 7
+
+
+def test_hash_join_inner_and_left(rt):
+    """Distributed hash join: inner matches pandas-style semantics incl.
+    duplicate keys; left join fills unmatched rows with NaN/None; column
+    collisions get the suffix (reference: Dataset.join)."""
+    left = rtd.from_items([
+        {"k": 1, "v": "a"}, {"k": 2, "v": "b"}, {"k": 2, "v": "b2"},
+        {"k": 3, "v": "c"},
+    ], override_num_blocks=2)
+    right = rtd.from_items([
+        {"k": 1, "w": 10.0, "v": "R1"},
+        {"k": 2, "w": 20.0, "v": "R2"},
+        {"k": 2, "w": 21.0, "v": "R2b"},
+        {"k": 9, "w": 90.0, "v": "R9"},
+    ], override_num_blocks=2)
+
+    inner = left.join(right, on="k").take_all()
+    got = sorted((r["k"], r["v"], r["w"], r["v_r"]) for r in inner)
+    # k=2 is 2x2 (duplicate keys on both sides); k=3/9 drop.
+    assert got == [
+        (1, "a", 10.0, "R1"),
+        (2, "b", 20.0, "R2"), (2, "b", 21.0, "R2b"),
+        (2, "b2", 20.0, "R2"), (2, "b2", 21.0, "R2b"),
+    ]
+
+    lj = left.join(right, on="k", how="left").take_all()
+    assert len(lj) == 6  # 5 matches + unmatched k=3
+    unmatched = [r for r in lj if r["k"] == 3]
+    assert len(unmatched) == 1
+    assert np.isnan(unmatched[0]["w"]) and unmatched[0]["v_r"] is None
+
+    # The exchange appears in the logical plan.
+    assert "HashJoin" in left.join(right, on="k").explain()
+
+
+def test_hash_join_empty_right_partitions(rt):
+    """A partition with left rows but NO right rows must still emit the
+    right-side columns (NaN/None-filled), keeping blocks schema-consistent
+    for concat and consumers."""
+    left = rtd.from_items([{"k": i, "v": i * 10} for i in builtins_range(8)],
+                          override_num_blocks=2)
+    right = rtd.from_items([{"k": 100, "w": 1.5}])
+    rows = left.join(right, on="k", how="left").take_all()
+    assert len(rows) == 8
+    for r in rows:
+        assert set(r) == {"k", "v", "w"}  # right column present everywhere
+        assert np.isnan(r["w"])
+    # Inner join against a disjoint right side: empty but well-formed.
+    assert left.join(right, on="k").count() == 0

@@ -139,3 +139,32 @@ def test_actor_pool_mixed_ordered_unordered(rt):
     assert not pool.has_next()
     # Counters reset: a fresh ordered map starts clean.
     assert list(pool.map(lambda a, v: a.work.remote(v), [5, 6])) == [10, 12]
+
+
+def test_multiprocessing_pool(rt):
+    """multiprocessing.Pool surface over cluster tasks (reference:
+    ray.util.multiprocessing — drop-in Pool for existing mp code)."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    def add(a, b, offset=0):
+        return a + b + offset
+
+    with Pool(processes=4) as pool:
+        assert pool.map(square, range(10)) == [i * i for i in range(10)]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(add, (5, 6), {"offset": 100}) == 111
+
+        ar = pool.map_async(square, range(6), chunksize=2)
+        ar.wait(timeout=60)
+        assert ar.ready() and ar.successful()
+        assert ar.get(timeout=30) == [i * i for i in range(6)]
+
+        assert list(pool.imap(square, range(8), chunksize=3)) == \
+            [i * i for i in range(8)]
+        assert sorted(pool.imap_unordered(square, range(8))) == \
+            sorted(i * i for i in range(8))
+    with pytest.raises(ValueError, match="closed"):
+        pool.map(square, [1])
