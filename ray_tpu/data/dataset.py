@@ -344,19 +344,32 @@ def _shuffle_merge(refs: List[Any], seed) -> Block:
     return block.take_rows(rng.permutation(block.num_rows))
 
 
+def _join_key_digestable(v) -> str:
+    """Canonical string for partition routing.  Values the probe-side dict
+    treats as EQUAL (python equality: 2 == 2.0) must digest identically,
+    or the same join returns different rows at different partition counts;
+    and hash() itself is salted per worker process, so a digest of this
+    canonical form is the only stable router."""
+    if hasattr(v, "item"):
+        v = v.item()
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return repr(v)
+    f = float(v)
+    if f == v and abs(f) < 2.0 ** 53:  # exactly representable: canonical
+        return repr(f)
+    return repr(v)
+
+
 @ray_tpu.remote
 def _hash_partition(block: Block, key: str, n_out: int) -> List[Block]:
-    """Route each row to hash(key) % n_out (submitted with
+    """Route each row to digest(key) % n_out (submitted with
     num_returns=n_out) — stage 1 of the join exchange."""
     keys = block.to_numpy()[key]
-    # Stable content hash per value (numpy's hash of scalars is fine for
-    # ints/strings via python hash, but hash() of str is salted per
-    # process — workers differ!  Use a deterministic digest instead.)
     import zlib
 
     which = np.fromiter(
-        (zlib.crc32(repr(v.item() if hasattr(v, "item") else v).encode())
-         % n_out for v in keys),
+        (zlib.crc32(_join_key_digestable(v).encode()) % n_out
+         for v in keys),
         dtype=np.int64, count=len(keys),
     )
     return [block.take_rows(np.flatnonzero(which == j))
@@ -422,25 +435,33 @@ def _hash_join_partition(left_refs: List[Any], right_refs: List[Any],
             continue
         out_name = name + suffix if name in lcols else name
         out[out_name] = col[ri_a]
-    if how == "left" and unmatched:
-        um = np.asarray(unmatched, np.int64)
-        for name, col in lcols.items():
-            out[name] = np.concatenate([out[name], col[um]])
-        n_um = len(um)
+    if how == "left":
+        # Nullable right columns upcast UNCONDITIONALLY (numeric->float64,
+        # else object): per-partition upcasting-only-when-unmatched would
+        # give the same output column different dtypes in different
+        # partitions.
         for name, col in rcols.items():
             if name == on:
                 continue
             out_name = name + suffix if name in lcols else name
-            matched = out[out_name]
             if np.issubdtype(col.dtype, np.number):
-                # Unmatched rows get NaN; integer columns upcast (the
-                # usual null-introducing join semantics).
-                matched = matched.astype(np.float64, copy=False)
-                fill = np.full(n_um, np.nan)
+                out[out_name] = out[out_name].astype(np.float64,
+                                                     copy=False)
             else:
-                matched = matched.astype(object, copy=False)
-                fill = np.full(n_um, None, object)
-            out[out_name] = np.concatenate([matched, fill])
+                out[out_name] = out[out_name].astype(object, copy=False)
+        if unmatched:
+            um = np.asarray(unmatched, np.int64)
+            for name, col in lcols.items():
+                out[name] = np.concatenate([out[name], col[um]])
+            n_um = len(um)
+            for name, col in rcols.items():
+                if name == on:
+                    continue
+                out_name = name + suffix if name in lcols else name
+                fill = (np.full(n_um, np.nan)
+                        if np.issubdtype(col.dtype, np.number)
+                        else np.full(n_um, None, object))
+                out[out_name] = np.concatenate([out[out_name], fill])
     return Block.from_batch(out) if out else Block({})
 
 

@@ -6,6 +6,12 @@ parallelism is a ShardingRules table consumed by pjit: DP/FSDP/TP/SP are
 configurations, not code paths.
 """
 
+from .generate import (
+    generate,
+    init_kv_cache,
+    llama_decode_step,
+    llama_prefill,
+)
 from .llama import (
     LlamaConfig,
     llama_apply,
@@ -22,6 +28,7 @@ from .train_state import TrainState, make_train_step
 
 __all__ = [
     "LlamaConfig", "llama_init", "llama_apply", "llama_loss",
+    "generate", "init_kv_cache", "llama_prefill", "llama_decode_step",
     "llama_sharding_rules", "lora_init", "lora_merge", "lora_sharding_rules",
     "MLPConfig", "mlp_init", "mlp_apply",
     "MoEConfig", "moe_init", "moe_apply", "moe_loss", "moe_sharding_rules",

@@ -45,7 +45,7 @@ class AsyncResult:
 
     def wait(self, timeout: Optional[float] = None) -> None:
         ray_tpu.wait(self._refs, num_returns=len(self._refs),
-                     timeout=3600.0 if timeout is None else timeout)
+                     timeout=-1.0 if timeout is None else timeout)
 
     def ready(self) -> bool:
         done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
@@ -67,10 +67,15 @@ class Pool:
     variants.  `processes` bounds in-flight chunks (defaults to the
     cluster's CPU count at first use)."""
 
+    _FN_CACHE_MAX = 32
+
     def __init__(self, processes: Optional[int] = None):
         self._processes = processes
         self._closed = False
         self._fn_cache: dict = {}
+        # Refs of submitted work, so join() can block until completion;
+        # pruned opportunistically to keep long-lived pools bounded.
+        self._inflight: List[Any] = []
 
     def _parallelism(self) -> int:
         if self._processes is None:
@@ -102,7 +107,16 @@ class Pool:
             import cloudpickle
 
             blob = self._fn_cache[fn] = cloudpickle.dumps(fn)
+            while len(self._fn_cache) > self._FN_CACHE_MAX:
+                self._fn_cache.pop(next(iter(self._fn_cache)))
         return blob
+
+    def _track(self, refs: List[Any]) -> None:
+        self._inflight.extend(refs)
+        if len(self._inflight) > 256:  # drop completed work's refs
+            done, rest = ray_tpu.wait(
+                self._inflight, num_returns=len(self._inflight), timeout=0)
+            self._inflight = list(rest)
 
     def _check_open(self):
         if self._closed:
@@ -139,8 +153,9 @@ class Pool:
         import cloudpickle
 
         blob = cloudpickle.dumps((fn, dict(kwds or {})))
-        return AsyncResult([_apply_one.remote(blob, tuple(args))],
-                           single=True)
+        refs = [_apply_one.remote(blob, tuple(args))]
+        self._track(refs)
+        return AsyncResult(refs, single=True)
 
     def map_async(self, fn: Callable, iterable: Iterable[Any],
                   chunksize: Optional[int] = None) -> AsyncResult:
@@ -149,6 +164,7 @@ class Pool:
         blob = self._blob(fn)
         refs = [_run_chunk.remote(blob, c, False)
                 for c in self._chunks(items, chunksize)]
+        self._track(refs)
         return AsyncResult(refs)
 
     def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
@@ -158,9 +174,23 @@ class Pool:
         blob = self._blob(fn)
         refs = [_run_chunk.remote(blob, c, True)
                 for c in self._chunks(items, chunksize)]
+        self._track(refs)
         return AsyncResult(refs)
 
     # -- streaming -----------------------------------------------------------
+
+    @staticmethod
+    def _chunk_iter(iterable, size: int):
+        """Lazily batch an iterable — imap must consume on demand
+        (an infinite generator is legal input)."""
+        buf: List[tuple] = []
+        for v in iterable:
+            buf.append((v,))
+            if len(buf) >= size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
 
     def imap(self, fn: Callable, iterable: Iterable[Any],
              chunksize: int = 1) -> Iterator[Any]:
@@ -168,8 +198,7 @@ class Pool:
         blob = self._blob(fn)
         window = self._parallelism() * 2
         pending: List[Any] = []
-        chunks = self._chunks([(v,) for v in iterable], chunksize)
-        it = iter(chunks)
+        it = self._chunk_iter(iterable, chunksize)
         exhausted = False
         while True:
             while not exhausted and len(pending) < window:
@@ -188,8 +217,7 @@ class Pool:
         blob = self._blob(fn)
         window = self._parallelism() * 2
         pending: List[Any] = []
-        chunks = self._chunks([(v,) for v in iterable], chunksize)
-        it = iter(chunks)
+        it = self._chunk_iter(iterable, chunksize)
         exhausted = False
         while True:
             while not exhausted and len(pending) < window:
@@ -219,6 +247,10 @@ class Pool:
     def join(self):
         if not self._closed:
             raise ValueError("join() before close()")
+        if self._inflight:
+            ray_tpu.wait(self._inflight,
+                         num_returns=len(self._inflight), timeout=-1.0)
+            self._inflight = []
 
     def __enter__(self):
         return self

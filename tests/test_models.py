@@ -371,3 +371,60 @@ class TestGradAccum:
                         jax.tree.leaves(s_full.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5, rtol=2e-4)
+
+
+class TestGenerate:
+    def test_kv_cache_decode_matches_full_forward(self):
+        """Greedy generation through the KV cache must produce exactly the
+        tokens a full re-forward per step would (cache correctness incl.
+        RoPE offsets, GQA repeat, length masking)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import LlamaConfig, llama_apply, llama_init
+        from ray_tpu.models.generate import generate
+
+        cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    cfg.vocab_size)
+        n_new = 6
+
+        out = generate(cfg, params, prompt, max_new_tokens=n_new)
+        assert out.shape == (2, 8 + n_new)
+        np.testing.assert_array_equal(np.asarray(out[:, :8]),
+                                      np.asarray(prompt))
+
+        # Reference: re-run the TRAINING forward on the growing sequence.
+        seq = prompt
+        for _ in range(n_new):
+            logits = llama_apply(cfg, params, seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    def test_generate_streaming_and_stop(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import LlamaConfig, llama_init
+        from ray_tpu.models.generate import generate
+
+        cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0,
+                                    cfg.vocab_size)
+        streamed = []
+        out = generate(cfg, params, prompt, max_new_tokens=5,
+                       stream=lambda t: streamed.append(int(t[0])))
+        assert len(streamed) == 5
+        assert streamed == [int(v) for v in out[0, 4:]]
+
+        # Temperature sampling is reproducible per seed and diverges
+        # across seeds (usually).
+        a = generate(cfg, params, prompt, max_new_tokens=8,
+                     temperature=1.0, seed=1)
+        b = generate(cfg, params, prompt, max_new_tokens=8,
+                     temperature=1.0, seed=1)
+        assert (a == b).all()
