@@ -203,17 +203,29 @@ class Autoscaler:
 
     def update(self):
         """One reconcile step: scale up on unmet demand, scale down idle
-        nodes past the timeout."""
+        nodes past the timeout.  Decisions are counted into
+        ``ray_tpu_autoscaler_decisions_total`` (tagged up/down) and current
+        demand into a gauge, so scaling behavior is auditable from the
+        metrics history."""
+        from ray_tpu.util.metrics import get_counter, get_gauge
+
         nodes = self.provider.non_terminated_nodes()
         snap = self._snapshot()
         demand = self._demand(snap)
+        get_gauge("ray_tpu_autoscaler_demand",
+                  "Unmet demand (runnable pending tasks + pending PGs)"
+                  ).set(demand)
+        decisions = get_counter("ray_tpu_autoscaler_decisions_total",
+                                "Autoscaler scale decisions",
+                                tag_keys=("action",))
         if demand > 0:
             # Never drain while demand exists — at max_nodes that would
             # churn create/terminate forever.
             if len(nodes) < self.max_nodes:
-                self.instance_manager.update(
-                    launch=min(self.upscaling_speed,
-                               self.max_nodes - len(nodes)))
+                launch = min(self.upscaling_speed,
+                             self.max_nodes - len(nodes))
+                self.instance_manager.update(launch=launch)
+                decisions.inc(launch, tags={"action": "scale_up"})
             return
         now = time.monotonic()
         for handle in nodes:
@@ -240,6 +252,7 @@ class Autoscaler:
                     # a terminal record whose node the provider still
                     # lists: terminate directly so nothing zombies.
                     self.provider.terminate_node(handle)
+                decisions.inc(1, tags={"action": "scale_down"})
                 self._idle_since.pop(key, None)
 
     # -- lifecycle -----------------------------------------------------------

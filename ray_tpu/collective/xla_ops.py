@@ -53,7 +53,7 @@ def ppermute(x, axis: str, perm: Sequence[tuple]):
 
 def shift(x, axis: str, offset: int = 1):
     """Ring shift: each shard receives from (i - offset) % n."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -69,4 +69,9 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    # Older jax has no lax.axis_size; psum of a literal 1 over the axis is
+    # the classic equivalent (concrete at trace time, NameError when the
+    # axis is unbound — same contract).
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)

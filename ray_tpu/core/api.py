@@ -575,16 +575,35 @@ def _inject_trace(spec: dict) -> None:
     """Propagate the active trace context into an outgoing task spec
     (reference: tracing_helper.py _DictPropagator injects the OTel span
     context into the spec's serialized runtime context).  The pre-assigned
-    task_span_id makes the execution span's identity stable across retries."""
+    task_span_id makes the execution span's identity stable across retries.
+
+    Each traced submission also records a zero-length *submit span* whose
+    ``attrs.flow_id`` is the execution span's pre-assigned id:
+    tracing.chrome_trace turns the pair into a flow arrow, so the timeline
+    shows the scheduling gap between submit and execute."""
+    import time as _time
+
     from ray_tpu.util import tracing
 
     parent = tracing.context_for_submit()
     if parent is not None:
+        task_span_id = tracing.new_id()
         spec["trace_ctx"] = {
             "trace_id": parent["trace_id"],
             "span_id": parent["span_id"],
-            "task_span_id": tracing._new_id(),
+            "task_span_id": task_span_id,
         }
+        now = _time.time()
+        tracing.emit_span({
+            "trace_id": parent["trace_id"],
+            "span_id": tracing.new_id(),
+            "parent_id": parent["span_id"],
+            "name": f"submit:{spec.get('name', 'task')}",
+            "start": now,
+            "end": now,
+            "pid": os.getpid(),
+            "attrs": {"flow_id": task_span_id},
+        })
 
 
 def _resources_from_options(o: dict, default_cpu: float = 1.0) -> Dict[str, float]:

@@ -103,6 +103,20 @@ class Config:
     # -- observability --------------------------------------------------------
     task_events_buffer_size: int = 100_000
     enable_timeline: bool = True
+    # Per-process metrics flusher cadence (util/metrics.py).  An atexit hook
+    # ships the final window regardless, so short-lived workers don't lose
+    # their last deltas.
+    metrics_flush_interval_s: float = 2.0
+    # Head-side time-series retention: each (metric, tags) series keeps a
+    # downsampled ring of this many samples, appended at most once per
+    # min-interval (reference: the dashboard's time-series panels read the
+    # GCS-aggregated OpenCensus views; here the head IS the store).
+    metrics_history_max_samples: int = 360
+    metrics_history_min_interval_s: float = 1.0
+    # Ceiling on distinct retained series — a tag-cardinality explosion
+    # must not grow head memory without bound; new series beyond the cap
+    # are dropped (the ones already retained keep recording).
+    metrics_history_max_series: int = 1024
 
     def __post_init__(self):
         if self.object_store_memory == 0:

@@ -91,6 +91,9 @@ class TaskRecord:
         self.spec = spec
         self.task_id = TaskID(spec["task_id"])
         self.state = PENDING
+        # Wall-clock submission time: feeds the built-in submit→start
+        # latency histogram at dispatch.
+        self.submit_time = time.time()
         self.pending_deps: Set[ObjectID] = set()
         self.worker_id: Optional[WorkerID] = None
         self.node_id: Optional[NodeID] = None
@@ -262,6 +265,11 @@ class Head:
         self._pending_frees: Dict[int, dict] = {}
         self._free_token = 0
         self.metrics_by_pid: Dict[int, list] = {}
+        # Counters/histograms of departed processes (see _retire_metrics):
+        # cluster totals must stay monotonic across worker churn.
+        self._metrics_retired: Dict[tuple, dict] = {}
+        # Cumulative store counters of departed NODES, same invariant.
+        self._store_retired: Dict[str, float] = {}
         self._state_dirty = True  # persist once at startup when configured
         # Lineage: finished task specs kept (args pinned) so lost objects can
         # be recomputed by re-running their creating task (reference:
@@ -287,6 +295,17 @@ class Head:
         self._shutdown = False
         self._kick_scheduled = False
         self.job_start_time = time.time()
+        # Built-in ray_tpu_* instruments + retained time-series history
+        # (see core/telemetry.py).  The history is fed by the periodic loop
+        # from the same aggregate `list_state(kind="metrics")` serves.
+        from .telemetry import HeadMetrics, MetricsHistory
+
+        self.builtin_metrics = HeadMetrics()
+        self.metrics_history = MetricsHistory(
+            max_samples=config.metrics_history_max_samples,
+            min_interval_s=config.metrics_history_min_interval_s,
+            max_series=config.metrics_history_max_series,
+        )
 
         for name in [
             "register", "kv_put", "kv_get", "kv_del", "kv_keys",
@@ -412,6 +431,10 @@ class Head:
                 await asyncio.sleep(period)
                 now = time.monotonic()
                 self.store.tick()  # cooled freed segments -> warm pool
+                try:
+                    self._sample_telemetry()
+                except Exception:
+                    pass
                 try:
                     self.persist_state()
                 except Exception:
@@ -842,11 +865,11 @@ class Head:
                 pass
         worker_id = self.conn_to_worker.pop(conn.conn_id, None)
         if conn.meta.get("pid") is not None:
-            self.metrics_by_pid.pop(conn.meta["pid"], None)
+            self._retire_metrics(conn.meta["pid"])
         if worker_id is not None:
             w = self.workers.get(worker_id)
             if w is not None:
-                self.metrics_by_pid.pop(w.pid, None)
+                self._retire_metrics(w.pid)
             if w is not None and w.pid in self.worker_pids:
                 # Exited zygote-forked worker: drop the pid now so a later
                 # shutdown can't signal a recycled pid.
@@ -858,7 +881,16 @@ class Head:
             self.node_object_addrs.pop(node_id, None)
             self.node_bulk_addrs.pop(node_id, None)
             self.node_last_ack.pop(node_id, None)
-            self.node_stats.pop(node_id, None)
+            # Fold the dead node's cumulative store counters into the
+            # retained baseline first — the cluster-wide *_total store
+            # gauges must not drop when a node leaves (same monotonicity
+            # rule as _retire_metrics).
+            st = self.node_stats.pop(node_id, None)
+            for k, v in (((st or {}).get("store")) or {}).items():
+                if k.endswith("_total") or k.startswith("gets_"):
+                    if isinstance(v, (int, float)):
+                        self._store_retired[k] = \
+                            self._store_retired.get(k, 0) + v
             damaged = self.scheduler.remove_node(node_id)
             if damaged:
                 # Bundles lost with the node get re-placed on survivors
@@ -1125,28 +1157,82 @@ class Head:
         self.metrics_by_pid[body["pid"]] = body["rows"]
         return {}
 
+    def _sample_telemetry(self):
+        """One telemetry tick: refresh the head's built-in gauges and append
+        a sample per live series to the retained history ring (the feed
+        behind list_state(kind="metrics_history") and the dashboard's
+        sparkline panels).  Skipped entirely inside the history's
+        min-interval: the cross-process aggregation isn't free and the
+        ring would drop the sample anyway."""
+        now = time.time()
+        if now - getattr(self, "_last_telemetry_sample", 0.0) \
+                < self.metrics_history.min_interval_s:
+            return
+        self._last_telemetry_sample = now
+        parked = sum(len(q) for q in self.node_parked.values())
+        self.builtin_metrics.queue_depth.set(
+            float(len(self.queued_tasks) + parked))
+        try:
+            # Cluster-wide store totals: the head's own store plus every
+            # remote daemon's latest stats push (h_node_stats) — remote
+            # nodes have no head-side ObjectStore object, only these dicts.
+            totals = dict(self.store.stats())
+            for k, v in self._store_retired.items():
+                totals[k] = totals.get(k, 0) + v
+            for st in self.node_stats.values():
+                remote = (st or {}).get("store") or {}
+                for k, v in remote.items():
+                    if isinstance(v, (int, float)):
+                        totals[k] = totals.get(k, 0) + v
+            self.builtin_metrics.sample_store(totals)
+        except Exception:
+            pass
+        self.metrics_history.record(self.metrics_rows())
+
+    @staticmethod
+    def _merge_metric_row(agg: Dict[tuple, dict], r: dict) -> None:
+        key = (r["name"], tuple(sorted(r.get("tags", {}).items())))
+        cur = agg.get(key)
+        if cur is None:
+            agg[key] = dict(r)
+        elif r["kind"] == "gauge":
+            cur["value"] = r["value"]  # last writer wins
+        else:
+            cur["value"] = cur.get("value", 0) + r.get("value", 0)
+            if "sum" in r:
+                cur["sum"] = cur.get("sum", 0) + r["sum"]
+                cur["count"] = cur.get("count", 0) + r["count"]
+                if r.get("buckets") and cur.get("buckets"):
+                    cur["buckets"] = [
+                        a + b for a, b in
+                        zip(cur["buckets"], r["buckets"])
+                    ]
+
+    def _retire_metrics(self, pid: int) -> None:
+        """A reporting process disconnected: its counters/histograms must
+        stay in the cluster totals (a counter vanishing reads as a negative
+        rate to any scraper) — fold them into the retired accumulator.
+        Gauges are point-in-time and die with the process."""
+        rows = self.metrics_by_pid.pop(pid, None)
+        if not rows:
+            return
+        for r in rows:
+            if r.get("kind") in ("counter", "histogram"):
+                self._merge_metric_row(self._metrics_retired, r)
+
     def metrics_rows(self) -> List[dict]:
         """Aggregate across processes: counters/histogram counts sum, gauges
-        keep the per-process latest (tagged by pid when colliding)."""
+        keep the per-process latest.  The head's own built-in instruments
+        (pid-less) and the counters of departed processes merge in
+        alongside."""
         agg: Dict[tuple, dict] = {}
-        for pid, rows in self.metrics_by_pid.items():
+        for r in self._metrics_retired.values():
+            self._merge_metric_row(agg, r)
+        sources = dict(self.metrics_by_pid)
+        sources[-1] = self.builtin_metrics.rows()  # head-internal builtins
+        for pid, rows in sources.items():
             for r in rows:
-                key = (r["name"], tuple(sorted(r.get("tags", {}).items())))
-                cur = agg.get(key)
-                if cur is None:
-                    agg[key] = dict(r)
-                elif r["kind"] == "gauge":
-                    cur["value"] = r["value"]  # last writer wins
-                else:
-                    cur["value"] = cur.get("value", 0) + r.get("value", 0)
-                    if "sum" in r:
-                        cur["sum"] = cur.get("sum", 0) + r["sum"]
-                        cur["count"] = cur.get("count", 0) + r["count"]
-                        if r.get("buckets") and cur.get("buckets"):
-                            cur["buckets"] = [
-                                a + b for a, b in
-                                zip(cur["buckets"], r["buckets"])
-                            ]
+                self._merge_metric_row(agg, r)
         return list(agg.values())
 
     async def h_put_object_batch(self, conn, body):
@@ -1874,7 +1960,14 @@ class Head:
         task.worker_id = worker.worker_id
         task.node_id = worker.node_id
         worker.used = True
+        # Scheduling latency counts only up to the FIRST dispatch: a retry
+        # after a worker death would otherwise fold the failed attempt's
+        # execution time into the histogram.
+        if task.start_time == 0.0:
+            self.builtin_metrics.submit_to_start.observe(
+                max(0.0, time.time() - task.submit_time))
         task.start_time = time.time()
+        self.builtin_metrics.tasks_dispatched.inc()
         worker.last_seen = time.monotonic()
         is_actor_creation = task.spec.get("is_actor_creation", False)
         worker.state = ACTOR if is_actor_creation else LEASED
@@ -2092,6 +2185,14 @@ class Head:
             "trace_id", "span_id", "parent_id", "name", "start", "end",
             "pid", "attrs",
         )})
+        # Task execution spans feed the built-in duration histogram — the
+        # trace↔metrics link: the same span that draws the timeline bar
+        # contributes to ray_tpu_task_duration_seconds.
+        start, end = body.get("start"), body.get("end")
+        if (str(body.get("name", "")).startswith("task:")
+                and isinstance(start, (int, float))
+                and isinstance(end, (int, float)) and end >= start):
+            self.builtin_metrics.task_duration.observe(end - start)
         return {}
 
     async def h_node_stats(self, conn, body):
@@ -2250,7 +2351,11 @@ class Head:
         task.worker_id = worker.worker_id
         task.node_id = worker.node_id
         worker.used = True
+        if task.start_time == 0.0:  # first dispatch only (see _dispatch)
+            self.builtin_metrics.submit_to_start.observe(
+                max(0.0, time.time() - task.submit_time))
         task.start_time = time.time()
+        self.builtin_metrics.tasks_dispatched.inc()
         worker.inflight.add(task.task_id)
         await worker.conn.push("execute_task", task.spec)
         return True
@@ -2724,6 +2829,9 @@ class Head:
             return {"items": list(self.task_events)}
         if kind == "metrics":
             return {"items": self.metrics_rows()}
+        if kind == "metrics_history":
+            return {"items": self.metrics_history.snapshot(
+                body.get("name_prefix", ""))}
         raise ValueError(f"unknown state kind {kind!r}")
 
     async def h_shutdown_cluster(self, conn, body):

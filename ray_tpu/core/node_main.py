@@ -117,6 +117,7 @@ class BulkServer(threading.Thread):
                         sent += os.sendfile(
                             conn.fileno(), fd, offset + sent, n - sent
                         )
+                    self._store.count_transferred(sent)
                 finally:
                     os.close(fd)
                     self._store.unpin(oid)
@@ -154,6 +155,7 @@ def make_pull_handler(store: ObjectStore):
         offset = body.get("offset", 0)
         max_bytes = body.get("max_bytes", PULL_CHUNK_BYTES)
         chunk = bytes(view[offset:offset + max_bytes])
+        store.count_transferred(len(chunk))
         return {"found": True, "size": len(view), "data": chunk}
 
     return h_pull_object

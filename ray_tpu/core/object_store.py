@@ -160,6 +160,12 @@ class ObjectStore:
         self._cooling_s = 0.0
         self._used = 0
         self.num_evictions = 0
+        # Telemetry counters (cumulative; surfaced via stats() and the
+        # head's ray_tpu_object_store_* built-in metrics).
+        self.bytes_stored_total = 0
+        self.bytes_transferred_total = 0
+        self.gets_hit = 0
+        self.gets_miss = 0
 
     # -- write path -----------------------------------------------------------
 
@@ -176,6 +182,7 @@ class ObjectStore:
                 seg = _Segment(path, size, create=True)
             self._objects[object_id] = seg
             self._used += size
+            self.bytes_stored_total += size
             return seg.view()
 
     def seal(self, object_id: ObjectID) -> int:
@@ -197,6 +204,7 @@ class ObjectStore:
             self._ensure_capacity(seg.size)
             self._objects[object_id] = seg
             self._used += seg.size
+            self.bytes_stored_total += seg.size
             return seg.size
 
     # -- read path ------------------------------------------------------------
@@ -206,10 +214,18 @@ class ObjectStore:
             seg = self._objects.get(object_id)
             if seg is not None:
                 self._objects.move_to_end(object_id)  # LRU touch
+                self.gets_hit += 1
                 return seg.view()
+            self.gets_miss += 1
             if object_id in self._spilled:
                 return self._restore(object_id)
             return None
+
+    def count_transferred(self, nbytes: int) -> None:
+        """Account bytes served to a cross-node pull (called by the pull
+        handlers in node_main)."""
+        with self._lock:
+            self.bytes_transferred_total += nbytes
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -371,6 +387,10 @@ class ObjectStore:
                 "num_objects": len(self._objects),
                 "num_spilled": len(self._spilled),
                 "num_evictions": self.num_evictions,
+                "bytes_stored_total": self.bytes_stored_total,
+                "bytes_transferred_total": self.bytes_transferred_total,
+                "gets_hit": self.gets_hit,
+                "gets_miss": self.gets_miss,
             }
 
 

@@ -672,7 +672,7 @@ class Worker:
                     # itself (the dispatch thread returns immediately).
                     span = tracing.task_span(spec, trace_start, time.time())
                     if span is not None:
-                        tracing._emit(span)
+                        tracing.emit_span(span)
             self.running_threads.pop(task_id, None)
             ctx.current_task_id = None
             if _DEBUG_PUSH:
@@ -780,7 +780,7 @@ class Worker:
                     tracing.reset_context(token)
                     span = tracing.task_span(spec, start, time.time())
                     if span is not None:
-                        tracing._emit(span)
+                        tracing.emit_span(span)
 
         asyncio.run_coroutine_threadsafe(run(), self.async_loop)
 

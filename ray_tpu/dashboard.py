@@ -13,11 +13,14 @@ are needed at this scale.
 Endpoints:
     /api/nodes /api/actors /api/tasks /api/workers /api/objects
     /api/placement_groups /api/timeline /api/metrics   -> {"items": [...]}
+    /api/metrics/history -> retained time series per (metric, tags):
+                            {"items": [{name, tags, kind, points: [[ts, v]]}]}
     /api/status   -> cluster resource totals/availability + process counts
     /api/jobs     -> submitted jobs (job_submission KV records)
     /api/summary  -> task counts by (name, state)
     /metrics      -> Prometheus exposition (scrapeable)
-    /             -> HTML UI (tabs per endpoint, auto-refresh)
+    /             -> HTML UI (tabs per endpoint + sparkline history panels,
+                     auto-refresh)
 
 Start via ``ray_tpu.init(include_dashboard=True)``, programmatically with
 ``Dashboard(addr).start()``, or ``python -m ray_tpu dashboard``.
@@ -55,6 +58,15 @@ _PAGE = """<!doctype html>
           text-overflow: ellipsis; white-space: nowrap; }
  th { background: #f4f4f8; position: sticky; top: 0; }
  .err { color: #b00; padding: 12px 16px; }
+ #content .sparks { display: flex; flex-wrap: wrap; gap: 12px;
+                    padding: 12px 16px; }
+ .spark { background: #f4f4f8; border-radius: 6px; padding: 8px 12px;
+          width: 280px; }
+ .spark .t { font-size: 11px; color: #555; overflow: hidden;
+             text-overflow: ellipsis; white-space: nowrap; }
+ .spark .v { font-size: 15px; font-weight: 600; }
+ .spark svg { display: block; width: 100%; height: 36px; }
+ .spark polyline { fill: none; stroke: #16213e; stroke-width: 1.5; }
 </style></head><body>
 <header><h1>ray_tpu dashboard</h1><span id="addr"></span></header>
 <nav id="nav"></nav>
@@ -62,7 +74,7 @@ _PAGE = """<!doctype html>
 <div id="content"></div>
 <script>
 const TABS = ["status","nodes","actors","tasks","workers","objects",
-              "placement_groups","jobs","metrics","summary"];
+              "placement_groups","jobs","metrics","history","summary"];
 let tab = location.hash.slice(1) || "status";
 const nav = document.getElementById("nav");
 TABS.forEach(t => {
@@ -93,6 +105,42 @@ function table(items) {
   }
   return h + "</table>";
 }
+function sparkline(points) {
+  if (!points.length) return "";
+  const vs = points.map(p => p[1]);
+  const lo = Math.min(...vs), hi = Math.max(...vs);
+  const span = hi - lo || 1;
+  const w = 256, h = 36, n = points.length;
+  const pts = points.map((p, i) => {
+    const x = n === 1 ? w / 2 : (i / (n - 1)) * w;
+    const y = h - 3 - ((p[1] - lo) / span) * (h - 6);
+    return `${x.toFixed(1)},${y.toFixed(1)}`;
+  }).join(" ");
+  return `<svg viewBox="0 0 ${w} ${h}"><polyline points="${pts}"/></svg>`;
+}
+function fmtv(v) {
+  if (!isFinite(v)) return String(v);
+  if (Math.abs(v) >= 1e6 || (v !== 0 && Math.abs(v) < 1e-3))
+    return v.toExponential(2);
+  return Number.isInteger(v) ? String(v) : v.toFixed(3);
+}
+function sparks(items) {
+  if (!items || !items.length)
+    return "<p style='margin:12px 16px'>(no retained series yet)</p>";
+  items = items.slice().sort((a, b) => a.name.localeCompare(b.name));
+  let h = "<div class='sparks'>";
+  for (const s of items.slice(0, 200)) {
+    const tags = Object.entries(s.tags || {})
+      .map(([k, v]) => `${k}=${v}`).join(",");
+    const last = s.points.length ? s.points[s.points.length - 1][1] : null;
+    h += `<div class="spark"><div class="t" title="${esc(s.name)}` +
+         `${tags ? "{" + esc(tags) + "}" : ""}">${esc(s.name)}` +
+         `${tags ? "{" + esc(tags) + "}" : ""}</div>` +
+         `<div class="v">${last === null ? "" : esc(fmtv(last))}</div>` +
+         sparkline(s.points) + `</div>`;
+  }
+  return h + "</div>";
+}
 async function render() {
   TABS.forEach(t => document.getElementById("tab-" + t)
     .classList.toggle("on", t === tab));
@@ -110,6 +158,11 @@ async function render() {
         return `<div class="stat"><b>${fmt(t - a)}/${fmt(t)}</b>${esc(r)} used</div>`;
       }).join("");
     if (tab === "status") { content.innerHTML = ""; return; }
+    if (tab === "history") {
+      const d = await getJSON("/api/metrics/history");
+      content.innerHTML = sparks(d.items);
+      return;
+    }
     const d = await getJSON("/api/" + tab);
     content.innerHTML = table(d.items);
   } catch (e) {
@@ -190,6 +243,10 @@ class Dashboard:
             return self._send_json(req, {"items": self._jobs()})
         if path == "/api/summary":
             return self._send_json(req, {"items": self._summary()})
+        if path == "/api/metrics/history":
+            return self._send_json(
+                req, self._call("list_state", {"kind": "metrics_history"})
+            )
         if path.startswith("/api/"):
             kind = path[len("/api/"):]
             if kind in _STATE_KINDS:

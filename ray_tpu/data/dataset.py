@@ -115,10 +115,51 @@ class _ReadTask:
         return f"Read{self.kind.capitalize()}"
 
 
+def _stage_name(source: Source, ops: List[Op]) -> str:
+    """Low-cardinality stage label: the fused op chain this part runs
+    (reference: each physical operator exports OpRuntimeMetrics tagged by
+    operator name)."""
+    parts = [getattr(source, "name", "Source") if callable(source)
+             else "Block"]
+    parts.extend(_op_name(op) for op in ops)
+    return "->".join(parts)[:120]
+
+
 def _exec_part_body(source: Source, ops: List[Op]) -> Block:
+    import time as _time
+
+    t0 = _time.perf_counter()
     block = source() if callable(source) else source
     for op in ops:
         block = op(block)
+    # Per-stage throughput telemetry: two counters per part (rows and
+    # wall-seconds, tagged by the fused stage) — rows/sec is their ratio,
+    # and its trend is visible in the head's metrics history.
+    try:
+        from ray_tpu.util.metrics import get_counter, get_gauge
+
+        wall = _time.perf_counter() - t0
+        tags = {"stage": _stage_name(source, ops)}
+        get_counter("ray_tpu_data_rows_total",
+                    "Rows produced per dataset stage",
+                    tag_keys=("stage",)).inc(block.num_rows, tags=tags)
+        get_counter("ray_tpu_data_stage_seconds_total",
+                    "Wall seconds spent per dataset stage",
+                    tag_keys=("stage",)).inc(wall, tags=tags)
+        if wall > 0:
+            # pid tag: gauges merge last-writer-wins per (name, tags) at
+            # the head, so parallel workers on one stage must stay
+            # distinct series (rate over the two counters above gives the
+            # stage-wide aggregate).
+            import os as _os
+
+            get_gauge("ray_tpu_data_rows_per_sec",
+                      "Rows/sec of the most recent part per stage/worker",
+                      tag_keys=("stage", "pid")).set(
+                block.num_rows / wall,
+                tags={**tags, "pid": str(_os.getpid())})
+    except Exception:
+        pass  # telemetry must never fail a data task
     return block
 
 

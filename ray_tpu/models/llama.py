@@ -185,8 +185,10 @@ def _attention(config: LlamaConfig, x, layer, cos, sin, lora_layer=None):
     # positions start at 0 and XLA partitions full attention itself.
     ring_mode = False
     if config.sp_ring:
+        from ..collective.xla_ops import axis_size
+
         try:
-            jax.lax.axis_size(AXIS_SP)
+            axis_size(AXIS_SP)  # probes whether the sp axis is bound
             ring_mode = True
         except (NameError, KeyError, TypeError):
             ring_mode = False

@@ -211,8 +211,12 @@ def ring_attention(
     sp axis is >1."""
     D = q.shape[-1]
     scale = sm_scale if sm_scale is not None else D ** -0.5
+    # Lazy import: the collective package pulls in core modules, which must
+    # not load as a side effect of importing this kernel module.
+    from ..collective.xla_ops import axis_size
+
     try:
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
     except NameError:
         n = 1
     if n == 1:

@@ -20,6 +20,7 @@ from .mesh import (
     batch_spec,
     data_sharding,
     make_mesh,
+    set_mesh,
 )
 from .pipeline import make_pp_loss, stack_layers, unstack_layers
 from .sharding import (
@@ -33,7 +34,7 @@ from .distributed import initialize_process_group, process_group_barrier
 
 __all__ = [
     "AXIS_DP", "AXIS_FSDP", "AXIS_TP", "AXIS_SP", "AXIS_EP", "AXIS_PP",
-    "MeshConfig", "make_mesh", "batch_spec", "data_sharding",
+    "MeshConfig", "make_mesh", "set_mesh", "batch_spec", "data_sharding",
     "make_pp_loss", "stack_layers", "unstack_layers",
     "ShardingRules", "infer_param_specs", "named_sharding", "shard_pytree",
     "with_sharding_constraint",

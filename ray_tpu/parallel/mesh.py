@@ -87,6 +87,16 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def set_mesh(mesh: Mesh):
+    """Version-tolerant ``jax.set_mesh``: newer jax installs the mesh as
+    the ambient (sharding-in-types) mesh; older jax lacks set_mesh, where
+    entering the Mesh context provides the equivalent ambient-mesh scope
+    for pjit-style programs."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
 def batch_spec(sp_shard_seq: bool = False) -> P:
     """PartitionSpec for a [batch, seq, ...] input batch: batch over dp+fsdp,
     optionally sequence over sp (context parallelism)."""

@@ -16,12 +16,31 @@ from typing import Any, Callable, List, Optional
 
 class _Batcher:
     def __init__(self, fn: Callable, max_batch_size: int,
-                 batch_wait_timeout_s: float):
+                 batch_wait_timeout_s: float, fn_name: str = ""):
+        from ..util.metrics import get_gauge, get_histogram
+
         self.fn = fn
+        self.fn_name = fn_name or getattr(fn, "__name__", "batch")
         self.max_batch_size = max_batch_size
         self.timeout = batch_wait_timeout_s
         self.queue: Optional[asyncio.Queue] = None
         self._task: Optional[asyncio.Task] = None
+        import os
+
+        self._m_size = get_histogram(
+            "ray_tpu_serve_batch_size",
+            "Items per @serve.batch invocation",
+            boundaries=(1, 2, 4, 8, 16, 32, 64, 128),
+            tag_keys=("fn",))
+        # The depth gauge carries a pid tag: each replica process runs its
+        # own batcher and same-(name, tags) gauges merge last-writer-wins
+        # at the head.
+        self._m_depth = get_gauge(
+            "ray_tpu_serve_batch_queue_depth",
+            "Requests waiting in the batcher queue",
+            tag_keys=("fn", "pid"))
+        self._m_tags = {"fn": self.fn_name}
+        self._m_depth_tags = {"fn": self.fn_name, "pid": str(os.getpid())}
 
     def _ensure_loop_state(self):
         if self.queue is None:
@@ -45,6 +64,8 @@ class _Batcher:
                     break
             args = [b[0] for b in batch]
             futs = [b[1] for b in batch]
+            self._m_size.observe(len(batch), tags=self._m_tags)
+            self._m_depth.set(self.queue.qsize(), tags=self._m_depth_tags)
             try:
                 results = await self.fn(args)
                 if len(results) != len(args):
@@ -85,7 +106,8 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                 async def call(items):
                     return await fn(self, items)
 
-                b = _Batcher(call, max_batch_size, batch_wait_timeout_s)
+                b = _Batcher(call, max_batch_size, batch_wait_timeout_s,
+                             fn_name=fn.__name__)
                 setattr(self, attr, b)
             return await b(item)
 

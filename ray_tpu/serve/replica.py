@@ -21,6 +21,10 @@ import ray_tpu
 class ServeReplica:
     def __init__(self, deployment_name: str, cls_blob: bytes,
                  init_args_blob: bytes):
+        import os
+
+        from ..util.metrics import get_gauge, get_histogram
+
         self.deployment_name = deployment_name
         cls = cloudpickle.loads(cls_blob)
         args, kwargs = cloudpickle.loads(init_args_blob)
@@ -28,6 +32,24 @@ class ServeReplica:
         self.user_fn = None if self.user is not None else cls
         self._ongoing = 0
         self._count_lock = threading.Lock()
+        # Auto-instrumentation, hoisted off the request path (instrument
+        # lookup takes the process-global registry lock).  Queue depth
+        # carries a pid tag: two replicas of one deployment must stay
+        # distinct series (the head's gauge merge is last-writer-wins
+        # per (name, tags)); the latency histogram sums safely across
+        # replicas so deployment alone suffices.
+        self._m_latency = get_histogram(
+            "ray_tpu_serve_request_latency_seconds",
+            "Serve request handling latency per deployment",
+            boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10),
+            tag_keys=("deployment",))
+        self._m_depth = get_gauge(
+            "ray_tpu_serve_replica_queue_depth",
+            "In-flight requests on this replica",
+            tag_keys=("deployment", "pid"))
+        self._m_tags = {"deployment": deployment_name}
+        self._m_depth_tags = {"deployment": deployment_name,
+                              "pid": str(os.getpid())}
 
     def ping(self) -> str:
         return "ok"
@@ -46,8 +68,12 @@ class ServeReplica:
 
     def _request_scope(self, model_id: str):
         """Ongoing-count + multiplex-model-id bracket shared by the unary
-        and streaming paths."""
+        and streaming paths.  Also the replica's auto-instrumentation
+        point: request latency histogram + queue-depth gauge (instruments
+        created in __init__; reference: serve's
+        ray_serve_deployment_request_* via the replica's metrics pusher)."""
         import contextlib
+        import time as _time
 
         from .multiplex import _reset_model_id, _set_model_id
 
@@ -55,13 +81,18 @@ class ServeReplica:
         def scope():
             with self._count_lock:
                 self._ongoing += 1
+                self._m_depth.set(self._ongoing, tags=self._m_depth_tags)
             token = _set_model_id(model_id)
+            start = _time.perf_counter()
             try:
                 yield
             finally:
+                self._m_latency.observe(_time.perf_counter() - start,
+                                        tags=self._m_tags)
                 _reset_model_id(token)
                 with self._count_lock:
                     self._ongoing -= 1
+                    self._m_depth.set(self._ongoing, tags=self._m_depth_tags)
 
         return scope()
 

@@ -19,6 +19,7 @@ from .config import (
     RunConfig,
     ScalingConfig,
 )
+from . import telemetry
 from .session import (
     get_checkpoint,
     get_context,
@@ -26,6 +27,7 @@ from .session import (
     get_mesh,
     report,
 )
+from .telemetry import TrainTelemetry
 from .trainer import DataParallelTrainer, JaxTrainer, TrainingFailedError
 
 __all__ = [
@@ -35,4 +37,5 @@ __all__ = [
     "Result", "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "get_mesh",
     "DataParallelTrainer", "JaxTrainer", "TrainingFailedError",
+    "telemetry", "TrainTelemetry",
 ]

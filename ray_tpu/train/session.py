@@ -46,12 +46,28 @@ class TrainSession:
         self.consumed = threading.Semaphore(0)
         self.step = 0
         self.finished = False
+        # Goodput accounting (train/telemetry.py): report() derives step
+        # time / tokens-per-sec / MFU per round and both sets the
+        # ray_tpu_train_* gauges and merges the numbers into the reported
+        # metrics.  Created lazily so sessions built directly in unit
+        # tests don't spin up the metrics flusher.
+        self._telemetry = None
+        self._last_report_t: Optional[float] = None
+
+    @property
+    def telemetry(self):
+        if self._telemetry is None:
+            from .telemetry import TrainTelemetry
+
+            self._telemetry = TrainTelemetry(rank=self.world_rank)
+        return self._telemetry
 
     # ---- called from user train loop ----------------------------------------
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self.step += 1
+        metrics = self._augment_metrics(dict(metrics))
         persisted = None
         if checkpoint is not None:
             # Stage the worker's checkpoint under the trial dir so it outlives
@@ -63,12 +79,40 @@ class TrainSession:
             shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
             persisted = dest
         self.result_queue.put(
-            {"metrics": dict(metrics), "checkpoint_dir": persisted,
+            {"metrics": metrics, "checkpoint_dir": persisted,
              "step": self.step, "rank": self.world_rank}
         )
         # Lockstep with the driver (reference behavior: session.report blocks
         # until the round is processed).
         self.consumed.acquire()
+        # Step time measures the user's loop body, not the driver's round
+        # processing: restart the clock after the lockstep wait returns.
+        import time as _time
+
+        self._last_report_t = _time.perf_counter()
+
+    def _augment_metrics(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        """Derive goodput numbers for this report round.  Step time is the
+        wall clock since the previous report returned (the user's loop
+        body); ``tokens``/``flops_per_step`` keys in the reported metrics
+        opt into tokens/sec and MFU.  User-provided keys always win."""
+        import time as _time
+
+        now = _time.perf_counter()
+        prev, self._last_report_t = self._last_report_t, now
+        if prev is None:
+            return metrics
+        try:
+            derived = self.telemetry.record_step(
+                now - prev,
+                tokens=metrics.get("tokens"),
+                flops=metrics.get("flops_per_step"),
+            )
+            for k, v in derived.items():
+                metrics.setdefault(k, v)
+        except Exception:
+            pass  # goodput accounting must never fail a training round
+        return metrics
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.restored_checkpoint

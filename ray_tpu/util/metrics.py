@@ -6,16 +6,22 @@ pipeline, src/ray/stats/metric.h): metric instruments are process-local and
 a background flusher ships deltas to the head, which aggregates across
 processes.  `list_state(kind="metrics")` (and the CLI `metrics` command)
 reads the aggregate; `prometheus_text()` renders the exposition format.
+
+Built-in framework metrics are namespaced ``ray_tpu_*`` (see
+core/telemetry.py for the head-side set and the retained time-series
+history behind ``list_state(kind="metrics_history")``).
 """
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _instruments: List["_Metric"] = []
+_named: Dict[Tuple[str, str], "_Metric"] = {}  # (kind, name) -> instrument
 _flusher_started = False
 
 
@@ -27,15 +33,19 @@ class _Metric:
     kind = "counter"
 
     def __init__(self, name: str, description: str = "",
-                 tag_keys: Sequence[str] = ()):
+                 tag_keys: Sequence[str] = (), register: bool = True):
+        """``register=False`` keeps the instrument out of the process
+        flusher — used by the head, which aggregates its own instruments
+        directly instead of reporting to itself over RPC."""
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
         self._values: Dict[Tuple, float] = {}
         self._lock = threading.Lock()
-        with _registry_lock:
-            _instruments.append(self)
-        _ensure_flusher()
+        if register:
+            with _registry_lock:
+                _instruments.append(self)
+            _ensure_flusher()
 
     def _snapshot(self) -> List[dict]:
         with self._lock:
@@ -71,8 +81,8 @@ class Histogram(_Metric):
 
     def __init__(self, name: str, description: str = "",
                  boundaries: Sequence[float] = (),
-                 tag_keys: Sequence[str] = ()):
-        super().__init__(name, description, tag_keys)
+                 tag_keys: Sequence[str] = (), register: bool = True):
+        super().__init__(name, description, tag_keys, register=register)
         self.boundaries = tuple(boundaries) or (
             0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10,
         )
@@ -109,6 +119,54 @@ class Histogram(_Metric):
             ]
 
 
+# -- memoized getters (auto-instrumentation call sites) -----------------------
+# Hot paths (serve request scope, data part execution) must not create a new
+# instrument per call: these return one process-wide instrument per name.
+
+
+def _get_named(key: Tuple[str, str], make) -> "_Metric":
+    """Lookup-or-create under ONE lock hold: constructing outside the lock
+    would let a racing first call register a duplicate instrument that the
+    flusher then snapshots forever.  The instrument is built unregistered
+    and inserted into the flusher registry only as the winner.
+
+    First call wins: description/boundaries/tag_keys passed by LATER calls
+    for the same (kind, name) are ignored, so call sites for one metric
+    must agree on its shape.  A name must also stick to one kind — the
+    same name as both counter and gauge would render an exposition that
+    Prometheus rejects as a duplicate-name conflict."""
+    with _registry_lock:
+        m = _named.get(key)
+        if m is None:
+            m = _named[key] = make()
+            _instruments.append(m)
+    _ensure_flusher()
+    return m
+
+
+def get_counter(name: str, description: str = "",
+                tag_keys: Sequence[str] = ()) -> Counter:
+    return _get_named(  # type: ignore[return-value]
+        ("counter", name),
+        lambda: Counter(name, description, tag_keys, register=False))
+
+
+def get_gauge(name: str, description: str = "",
+              tag_keys: Sequence[str] = ()) -> Gauge:
+    return _get_named(  # type: ignore[return-value]
+        ("gauge", name),
+        lambda: Gauge(name, description, tag_keys, register=False))
+
+
+def get_histogram(name: str, description: str = "",
+                  boundaries: Sequence[float] = (),
+                  tag_keys: Sequence[str] = ()) -> Histogram:
+    return _get_named(  # type: ignore[return-value]
+        ("histogram", name),
+        lambda: Histogram(name, description, boundaries, tag_keys,
+                          register=False))
+
+
 def _flush_once():
     from ..core.context import ctx
 
@@ -129,6 +187,30 @@ def _flush_once():
             pass
 
 
+def _flush_interval() -> float:
+    try:
+        from ..core.config import get_config
+
+        return max(0.1, float(get_config().metrics_flush_interval_s))
+    except Exception:
+        return 2.0
+
+
+def _final_flush():
+    """atexit hook: ship the last window of deltas so short-lived workers
+    (a task-pool worker reaped right after its task, a driver script that
+    exits immediately) don't lose their final metrics."""
+    try:
+        _flush_once()
+        from ..core.context import ctx
+
+        # Short drain bound: a wedged head must not stall process exit.
+        if ctx.client is not None:
+            ctx.client.drain_bg(timeout=2.0)
+    except Exception:
+        pass
+
+
 def _ensure_flusher():
     global _flusher_started
     with _registry_lock:
@@ -138,24 +220,72 @@ def _ensure_flusher():
 
     def loop():
         while True:
-            time.sleep(2.0)
+            time.sleep(_flush_interval())
             _flush_once()
 
     threading.Thread(target=loop, daemon=True, name="metrics-flush").start()
+    atexit.register(_final_flush)
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+
+def _escape_label(v) -> str:
+    """Escape a label value per the exposition format: backslash, quote,
+    and newline must be escaped inside the double-quoted value."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(tags: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in sorted(tags.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
 
 
 def prometheus_text(rows: List[dict]) -> str:
     """Render aggregated metric rows in the Prometheus exposition format
-    (reference: _private/prometheus_exporter.py)."""
+    (reference: _private/prometheus_exporter.py).  Histograms emit the full
+    spec shape: cumulative ``name_bucket{le="..."}`` series ending in
+    ``le="+Inf"``, plus ``name_sum`` and ``name_count``."""
     out = []
     seen = set()
     for r in rows:
-        if r["name"] not in seen:
-            seen.add(r["name"])
+        name = r["name"]
+        kind = r.get("kind", "counter")
+        if name not in seen:
+            seen.add(name)
             if r.get("description"):
-                out.append(f"# HELP {r['name']} {r['description']}")
-            out.append(f"# TYPE {r['name']} {r['kind']}")
-        tag_s = ",".join(f'{k}="{v}"' for k, v in r.get("tags", {}).items())
-        label = f"{{{tag_s}}}" if tag_s else ""
-        out.append(f"{r['name']}{label} {r['value']}")
+                desc = str(r["description"]).replace("\\", "\\\\") \
+                                            .replace("\n", "\\n")
+                out.append(f"# HELP {name} {desc}")
+            out.append(f"# TYPE {name} {kind}")
+        tags = r.get("tags", {})
+        if kind == "histogram" and r.get("boundaries") is not None:
+            buckets = list(r.get("buckets") or [])
+            bounds = list(r["boundaries"])
+            # Per-bucket counts -> cumulative counts per the spec.
+            cum = 0.0
+            for bound, n in zip(bounds, buckets):
+                cum += n
+                le = _label_str(tags, f'le="{_fmt(bound)}"')
+                out.append(f"{name}_bucket{le} {_fmt(cum)}")
+            if len(buckets) > len(bounds):
+                cum += buckets[-1]
+            inf = _label_str(tags, 'le="+Inf"')
+            count = r.get("count", cum)
+            # +Inf must equal _count even when bucket data is missing.
+            out.append(f"{name}_bucket{inf} {_fmt(max(cum, count))}")
+            label = _label_str(tags)
+            out.append(f"{name}_sum{label} {_fmt(r.get('sum', 0.0))}")
+            out.append(f"{name}_count{label} {_fmt(count)}")
+        else:
+            label = _label_str(tags)
+            out.append(f"{name}{label} {r['value']}")
     return "\n".join(out) + "\n"

@@ -30,8 +30,13 @@ _current: contextvars.ContextVar[Optional[Dict[str, str]]] = (
 )
 
 
-def _new_id() -> str:
+def new_id() -> str:
+    """A fresh 64-bit hex span/trace id (public — use this instead of the
+    legacy private ``_new_id``)."""
     return os.urandom(8).hex()
+
+
+_new_id = new_id  # backward-compat alias
 
 
 def current_context() -> Optional[Dict[str, str]]:
@@ -55,8 +60,11 @@ def reset_context(token) -> None:
     _current.reset(token)
 
 
-def _emit(span: Dict[str, Any]) -> None:
-    """Record a finished span into the cluster timeline (best-effort)."""
+def emit_span(span: Dict[str, Any]) -> None:
+    """Record a finished span into the cluster timeline (best-effort).
+    Public — use this instead of the legacy private ``_emit``.  The span
+    dict needs at least trace_id/span_id/name; start/end are float
+    timestamps in seconds."""
     from ..core.context import ctx as rt_ctx
 
     if rt_ctx.client is None:
@@ -67,14 +75,17 @@ def _emit(span: Dict[str, Any]) -> None:
         pass
 
 
+_emit = emit_span  # backward-compat alias
+
+
 @contextlib.contextmanager
 def trace(name: str, **attrs):
     """A named span.  Nested spans and tasks submitted inside it become
     children; the finished span lands in the cluster timeline."""
     parent = _current.get()
     span_ctx = {
-        "trace_id": parent["trace_id"] if parent else _new_id(),
-        "span_id": _new_id(),
+        "trace_id": parent["trace_id"] if parent else new_id(),
+        "span_id": new_id(),
     }
     token = _current.set(span_ctx)
     start = time.time()
@@ -82,7 +93,7 @@ def trace(name: str, **attrs):
         yield span_ctx
     finally:
         _current.reset(token)
-        _emit({
+        emit_span({
             "trace_id": span_ctx["trace_id"],
             "span_id": span_ctx["span_id"],
             "parent_id": parent["span_id"] if parent else None,
@@ -103,7 +114,7 @@ def task_span(spec: Dict[str, Any], start: float, end: float) -> Optional[dict]:
         return None
     return {
         "trace_id": injected["trace_id"],
-        "span_id": injected.get("task_span_id") or _new_id(),
+        "span_id": injected.get("task_span_id") or new_id(),
         "parent_id": injected.get("span_id"),
         "name": f"task:{spec.get('name', 'anonymous')}",
         "start": start,
@@ -115,14 +126,22 @@ def task_span(spec: Dict[str, Any], start: float, end: float) -> Optional[dict]:
 def chrome_trace(events) -> list:
     """Convert timeline span events into Chrome trace-event JSON (the
     `ray timeline` output format — reference: chrome://tracing 'X' complete
-    events keyed by pid/tid)."""
+    events keyed by pid/tid).
+
+    Submission spans carry ``attrs.flow_id`` (the pre-assigned execution
+    span id, see api._inject_trace): each such pair additionally emits a
+    flow-event arrow ('s' at the submit span's end, 'f' at the execution
+    span's start) so the timeline renders the scheduling gap between
+    submit and execute as a visible edge."""
     out = []
+    spans = []
     for ev in events:
         if ev.get("kind") != "span":
             continue
         if not isinstance(ev.get("start"), (int, float)) \
                 or not isinstance(ev.get("end"), (int, float)):
             continue  # malformed emitter: skip, don't kill the export
+        spans.append(ev)
         out.append({
             "name": ev.get("name", "span"),
             "cat": ev.get("trace_id", ""),
@@ -138,4 +157,22 @@ def chrome_trace(events) -> list:
                 **(ev.get("attrs") or {}),
             },
         })
+    # Flow arrows: submit span (attrs.flow_id) -> execution span (span_id).
+    flow_starts = {}
+    for ev in spans:
+        flow = (ev.get("attrs") or {}).get("flow_id")
+        if flow:
+            flow_starts[flow] = ev
+    if flow_starts:
+        for ev in spans:
+            sub = flow_starts.get(ev.get("span_id"))
+            if sub is None or ev is sub:
+                continue
+            common = {"cat": "scheduling", "id": ev["span_id"],
+                      "name": "submit_to_start"}
+            out.append({**common, "ph": "s", "ts": sub["end"] * 1e6,
+                        "pid": sub.get("pid", 0), "tid": sub.get("pid", 0)})
+            out.append({**common, "ph": "f", "bp": "e",
+                        "ts": ev["start"] * 1e6,
+                        "pid": ev.get("pid", 0), "tid": ev.get("pid", 0)})
     return out

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Canonical tier-1 verification gate (the exact ROADMAP.md command):
+# CPU-only pytest over tests/, excluding slow tests, with a dot-count
+# summary.  CI and the builder invoke this one script so the gate can't
+# drift between them.
+#
+# Usage: scripts/verify.sh [extra pytest args...]
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+LOG="${T1_LOG:-/tmp/_t1.log}"
+TIMEOUT="${T1_TIMEOUT:-870}"
+rm -f "$LOG"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" \
+    | tr -cd . | wc -c)"
+exit "$rc"

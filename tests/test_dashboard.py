@@ -75,6 +75,42 @@ def test_html_and_prometheus(dash_cluster):
     assert status == 200
 
 
+def test_metrics_and_history_scrape(dash_cluster):
+    """/metrics exposes built-in histograms per the Prometheus spec and
+    /api/metrics/history retains >=2 timestamped samples per series."""
+    import time
+
+    ray_tpu, dash = dash_cluster
+
+    @ray_tpu.remote
+    def tick(x):
+        return x
+
+    assert ray_tpu.get(tick.remote(1)) == 1
+    status, body = _get(dash.url + "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "# TYPE ray_tpu_scheduler_submit_to_start_seconds histogram" \
+        in text
+    assert 'ray_tpu_scheduler_submit_to_start_seconds_bucket{le="+Inf"}' \
+        in text
+    assert "ray_tpu_scheduler_submit_to_start_seconds_count" in text
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        status, body = _get(dash.url + "/api/metrics/history")
+        assert status == 200
+        items = json.loads(body)["items"]
+        builtin = [s for s in items if s["name"].startswith("ray_tpu_")
+                   and len(s["points"]) >= 2]
+        if builtin:
+            ts = [p[0] for p in builtin[0]["points"]]
+            assert ts == sorted(ts) and ts[0] > 0
+            return
+        time.sleep(0.3)
+    raise AssertionError("no built-in series with >=2 retained samples")
+
+
 def test_unknown_path_404(dash_cluster):
     _, dash = dash_cluster
     with pytest.raises(urllib.error.HTTPError) as ei:

@@ -26,7 +26,7 @@ from ray_tpu.models.mlp import mlp_loss
 from ray_tpu.models.train_state import default_optimizer, shard_train_state
 from jax.sharding import PartitionSpec as P
 
-from ray_tpu.parallel import MeshConfig, make_mesh
+from ray_tpu.parallel import MeshConfig, make_mesh, set_mesh
 
 
 @pytest.fixture(scope="module")
@@ -97,7 +97,7 @@ class TestLlama:
         )
         step_s = make_train_step(loss_fn, tx, mesh, rules)
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             for _ in range(2):
                 state_s, m_s = step_s(state_s, batch)
         for _ in range(2):
@@ -257,7 +257,7 @@ class TestMoE:
         )
         step_s = make_train_step(loss_fn, tx, mesh, rules)
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             for _ in range(2):
                 state_s, m_s = step_s(state_s, batch)
         for _ in range(2):
@@ -290,7 +290,7 @@ class TestPipelineParallel:
         mesh = make_mesh(MeshConfig(fsdp=2, pp=4))
         stacked = stack_layers(params)
         pp_loss = make_pp_loss(cfg, mesh, n_micro=4)
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             got = float(jax.jit(pp_loss)(stacked, toks, targets))
         assert abs(got - ref) < 1e-4, (got, ref)
 
@@ -322,7 +322,7 @@ class TestPipelineParallel:
             return optax.apply_updates(params, updates), opt_state, loss, grads
 
         losses = []
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             for _ in range(6):
                 params, opt_state, loss, grads = step(params, opt_state)
                 losses.append(float(loss))

@@ -100,7 +100,7 @@ class TestRingAttention:
         q, k, v = _qkv(B=B, H=H, S=S, D=D, seed=3)
         ref = mha_reference(q, k, v, causal=True)
 
-        from jax import shard_map
+        from ray_tpu.parallel.pipeline import shard_map  # version-tolerant
 
         ring = shard_map(
             functools.partial(ring_attention, axis_name="sp", causal=True),
@@ -115,7 +115,7 @@ class TestRingAttention:
     def test_grad_flows(self):
         mesh = make_mesh(MeshConfig(fsdp=1, sp=8))
         q, k, v = _qkv(B=1, H=2, S=128, D=32)
-        from jax import shard_map
+        from ray_tpu.parallel.pipeline import shard_map  # version-tolerant
 
         ring = shard_map(
             functools.partial(ring_attention, axis_name="sp", causal=True),
@@ -144,7 +144,7 @@ class TestRingAttention:
         B, H, S, D = 1, 2, 256, 32
         q, k, v = _qkv(B=B, H=H, S=S, D=D, seed=5)
         ref = mha_reference(q, k, v, causal=True)
-        from jax import shard_map
+        from ray_tpu.parallel.pipeline import shard_map  # version-tolerant
 
         mesh4 = _Mesh(_np.array(jax.devices()[:4]).reshape(1, 1, 1, 4),
                       ("dp", "fsdp", "tp", "sp"))
@@ -166,7 +166,7 @@ class TestRingAttention:
         mesh4 = _Mesh(_np.array(jax.devices()[:4]).reshape(1, 1, 1, 4),
                       ("dp", "fsdp", "tp", "sp"))
         q, k, v = _qkv(B=1, H=2, S=256, D=32, seed=6)
-        from jax import shard_map
+        from ray_tpu.parallel.pipeline import shard_map  # version-tolerant
 
         ring = shard_map(
             functools.partial(ring_attention, axis_name="sp", causal=True,
@@ -200,7 +200,7 @@ class TestRingAttention:
         q = jax.random.normal(kq, (1, 4, 256, 32), jnp.float32)
         k = jax.random.normal(kk, (1, 2, 256, 32), jnp.float32)
         v = jax.random.normal(kv, (1, 2, 256, 32), jnp.float32)
-        from jax import shard_map
+        from ray_tpu.parallel.pipeline import shard_map  # version-tolerant
 
         ring = shard_map(
             functools.partial(ring_attention, axis_name="sp", causal=True,
