@@ -65,26 +65,37 @@ class LocalNodeProvider(NodeProvider):
     """Adds node-daemon processes on this machine."""
 
     def __init__(self, num_cpus: int = 2,
-                 resources: Optional[Dict[str, float]] = None):
+                 resources: Optional[Dict[str, float]] = None,
+                 drain_grace_s: Optional[float] = None):
         import os
 
         from ..cluster_utils import Cluster
 
         self.num_cpus = num_cpus
         self.resources = resources
+        # Drain grace for nodes this provider creates.  The grace belongs
+        # to the NODE (it answers any future SIGTERM, including a real
+        # preemption of a backfilled gang host), so the default inherits
+        # the daemon's standard window rather than baking in a short one;
+        # tests that churn nodes can pass a small value for speed.
+        self.drain_grace_s = drain_grace_s
         self._nodes: List[object] = []
         self._cluster = Cluster.attach(os.environ["RT_ADDRESS"])
 
     def create_node(self):
         handle = self._cluster.add_node(
-            num_cpus=self.num_cpus, resources=self.resources
+            num_cpus=self.num_cpus, resources=self.resources,
+            drain_grace_s=self.drain_grace_s,
         )
         self._nodes.append(handle)
         return handle
 
     def terminate_node(self, handle):
         try:
-            self._cluster.remove_node(handle, graceful=True)
+            # wait=False: the reconcile loop must not block on the node's
+            # drain cycle (head round-trip + daemon linger); the cluster
+            # reaps the daemon opportunistically once it exits.
+            self._cluster.remove_node(handle, graceful=True, wait=False)
         except Exception:
             logger.exception("terminate_node failed; keeping handle")
             return
@@ -190,6 +201,10 @@ class Autoscaler:
     def _node_busy(snap: dict, node_hex: str) -> bool:
         for n in snap["nodes"]:
             if n["node_id"] == node_hex:
+                if n.get("draining"):
+                    # Already being preempted/terminated: never double-
+                    # terminate, and never count it as idle capacity.
+                    return True
                 total = n.get("resources", {})
                 avail = n.get("available", {})
                 if any(avail.get(k, 0) < v for k, v in total.items()):

@@ -195,7 +195,11 @@ class TpuSliceNodeProvider(NodeProvider):
     def terminate_node(self, handle: _SliceHandle) -> None:
         for h in handle.host_handles:
             try:
-                self._cluster.remove_node(h, graceful=True)
+                # wait=False: blocking on each host's drain cycle would
+                # stall the reconcile thread for hosts_per_slice × the
+                # daemon linger; the SIGTERM announces the drain and the
+                # cluster reaps the daemons as they exit.
+                self._cluster.remove_node(h, graceful=True, wait=False)
             except Exception:
                 logger.exception("slice host drain failed")
         self.api.delete(node_id=handle.slice_id)

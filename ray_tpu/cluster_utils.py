@@ -25,10 +25,14 @@ from ray_tpu.core.ids import NodeID
 
 
 class NodeHandle:
-    def __init__(self, node_id: NodeID, proc: subprocess.Popen, session: str):
+    def __init__(self, node_id: NodeID, proc: subprocess.Popen, session: str,
+                 drain_grace_s: Optional[float] = None):
         self.node_id = node_id
         self.proc = proc
         self.session = session
+        # Grace window this node's daemon honors on SIGTERM (None = the
+        # daemon default); graceful removal waits must outlast it.
+        self.drain_grace_s = drain_grace_s
 
     @property
     def hex(self) -> str:
@@ -54,6 +58,10 @@ class Cluster:
         self.head_addr = os.environ["RT_ADDRESS"]
         self.head_node_id: NodeID = ctx.client.node_id
         self.nodes: List[NodeHandle] = []
+        # Nodes preempted (SIGTERM'd) but possibly still draining: no
+        # longer schedulable members, yet shutdown must still kill and
+        # reap their daemons (a test can finish inside the grace window).
+        self._preempted: List[NodeHandle] = []
         # Every session this cluster ever created (including killed nodes,
         # whose daemons died before they could clean /dev/shm) — swept on
         # shutdown so crash-simulation tests don't leak segments.
@@ -70,6 +78,7 @@ class Cluster:
 
         self.head_node_id = ctx.client.node_id if ctx.client else None
         self.nodes = []
+        self._preempted = []
         self._sessions = []
         return self
 
@@ -80,6 +89,7 @@ class Cluster:
         num_workers: Optional[int] = None,
         labels: Optional[Dict[str, str]] = None,
         timeout: float = 30.0,
+        drain_grace_s: Optional[float] = None,
     ) -> NodeHandle:
         node_id = NodeID.from_random()
         session = f"node-{os.urandom(6).hex()}"
@@ -107,6 +117,10 @@ class Cluster:
             ),
             JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
         )
+        if drain_grace_s is not None:
+            # Grace window between SIGTERM (preemption notice) and daemon
+            # exit — the window a training gang has to checkpoint.
+            env["RT_DRAIN_GRACE_S"] = str(drain_grace_s)
         log_dir = os.path.join("/tmp/ray_tpu_logs", session)
         os.makedirs(log_dir, exist_ok=True)
         logf = open(os.path.join(log_dir, "node-daemon.log"), "wb")
@@ -117,7 +131,7 @@ class Cluster:
             stderr=subprocess.STDOUT,
         )
         logf.close()
-        handle = NodeHandle(node_id, proc, session)
+        handle = NodeHandle(node_id, proc, session, drain_grace_s)
         self._sessions.append(session)
         self._wait_registered(node_id, timeout)
         self.nodes.append(handle)
@@ -133,16 +147,56 @@ class Cluster:
             time.sleep(0.05)
         raise TimeoutError(f"node {want[:12]} did not register in {timeout}s")
 
-    def remove_node(self, node: NodeHandle, graceful: bool = False):
-        """Kill a node daemon (SIGKILL = crash simulation).  The head notices
-        the disconnect, fails over its tasks/actors, and purges its object
-        locations."""
+    def preempt_node(self, node: NodeHandle) -> NodeHandle:
+        """Announce a preemption: SIGTERM the daemon and return immediately.
+        The node reports DRAINING to the head, keeps running through its
+        grace window (RT_DRAIN_GRACE_S / add_node(drain_grace_s=...)), then
+        exits — the spot/maintenance preemption shape, vs remove_node's
+        wait-for-death."""
+        try:
+            node.proc.send_signal(signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        if node in self.nodes:
+            self.nodes.remove(node)
+        self._preempted.append(node)
+        return node
+
+    def remove_node(self, node: NodeHandle, graceful: bool = False,
+                    wait: bool = True):
+        """Kill a node daemon (SIGKILL = crash simulation; graceful=True
+        drains first).  The head notices the disconnect, fails over its
+        tasks/actors, and purges its object locations.
+
+        ``wait=False`` (graceful only) returns right after the SIGTERM and
+        reaps the daemon opportunistically — the autoscaler's scale-down
+        path uses it so its single reconcile thread never blocks on a
+        drain cycle (a drain is ~a second even for an idle node)."""
         sig = signal.SIGTERM if graceful else signal.SIGKILL
         try:
             node.proc.send_signal(sig)
         except ProcessLookupError:
             pass
-        node.proc.wait(timeout=10)
+        if graceful and not wait:
+            if node in self.nodes:
+                self.nodes.remove(node)
+            self._preempted.append(node)
+            # Opportunistic reap of earlier no-wait removals/preemptions so
+            # a long-lived autoscaler doesn't accumulate zombies (poll()
+            # reaps an exited child); shutdown sweeps whatever remains.
+            for prev in list(self._preempted):
+                if prev is not node and prev.proc.poll() is not None:
+                    self._preempted.remove(prev)
+            return
+        # A graceful remove rides the drain protocol: the daemon exits only
+        # after its grace window, so the wait must outlast it — including
+        # custom (long) grace windows set at add_node time.
+        if graceful:
+            grace = node.drain_grace_s if node.drain_grace_s is not None \
+                else float(os.environ.get("RT_DRAIN_GRACE_S", "5"))
+            node.proc.wait(timeout=grace + 30)
+        else:
+            node.proc.wait(timeout=10)
         deadline = time.monotonic() + 10
         want = node.hex
         while time.monotonic() < deadline:
@@ -153,12 +207,19 @@ class Cluster:
             self.nodes.remove(node)
 
     def shutdown(self):
-        for node in list(self.nodes):
+        # Preempted daemons may still be inside their grace window: kill
+        # and reap them too, or they outlive the cluster (and zombie).
+        for node in list(self.nodes) + self._preempted:
             try:
                 node.proc.kill()
             except ProcessLookupError:
                 pass
+            try:
+                node.proc.wait(timeout=10)
+            except Exception:
+                pass
         self.nodes.clear()
+        self._preempted.clear()
         ray_tpu.shutdown()
         # Sweep segments left by nodes that died without cleanup (SIGKILL
         # crash simulation): the store daemon owns unlinking in normal

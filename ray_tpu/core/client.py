@@ -22,6 +22,19 @@ from .ids import NodeID, ObjectID
 from .object_store import StoreClient
 from .rpc import ConnectionLost, RpcClient
 
+# Head RPCs that are safe to retry on a transient connection hiccup: pure
+# reads (no head-side state mutation), so a duplicate delivery is harmless
+# (reference: GCS clients retry idempotent RPCs with backoff —
+# gcs_rpc_client.h RETRYABLE macros cover the read paths).
+IDEMPOTENT_METHODS = frozenset({
+    "list_state", "kv_get", "kv_keys", "cluster_resources",
+    "available_resources", "store_stats", "object_sizes", "ping",
+    "get_actor_by_name", "list_named_actors", "health_ack",
+})
+#: attempts / base delay for the jittered exponential backoff below.
+IDEMPOTENT_RETRY_ATTEMPTS = 3
+IDEMPOTENT_RETRY_BASE_S = 0.05
+
 
 class Client:
     def __init__(
@@ -35,6 +48,7 @@ class Client:
     ):
         from . import schema as wire_schema
 
+        self.head_addr = head_addr
         host, port = head_addr.rsplit(":", 1)
         self.rpc = RpcClient(host, int(port), name=f"{kind}-rpc")
         body: Dict[str, Any] = {
@@ -109,9 +123,11 @@ class Client:
         # Free-queue flusher: ObjectRef.__del__ only appends + signals (it
         # may run from cyclic GC inside a client critical section, so it
         # must never take client locks itself); this thread does the RPCs.
-        threading.Thread(
+        self._reconnect_lock = threading.Lock()
+        self._free_flusher = threading.Thread(
             target=self._free_flush_loop, daemon=True, name="free-flusher"
-        ).start()
+        )
+        self._free_flusher.start()
 
     def _free_flush_loop(self):
         from . import object_ref as oref
@@ -703,13 +719,15 @@ class Client:
         )["added"]
 
     def kv_get(self, key: str) -> Optional[bytes]:
-        return self.rpc.call("kv_get", {"key": key})["value"]
+        # Via call(): kv_get is in IDEMPOTENT_METHODS, so transient
+        # connection errors retry instead of failing rendezvous/polling.
+        return self.call("kv_get", {"key": key})["value"]
 
     def kv_del(self, key: str) -> bool:
         return self.rpc.call("kv_del", {"key": key})["deleted"]
 
     def kv_keys(self, prefix: str = "") -> List[str]:
-        return self.rpc.call("kv_keys", {"prefix": prefix})["keys"]
+        return self.call("kv_keys", {"prefix": prefix})["keys"]
 
     # -- pubsub ----------------------------------------------------------------
 
@@ -729,6 +747,15 @@ class Client:
             self._sub_handlers.setdefault(topic, []).append(handler)
         self.rpc.call("subscribe", {"topic": topic})
 
+    def unsubscribe(self, topic: str, handler: Callable[[Any], None]) -> None:
+        """Drop a local handler registered via subscribe().  The server-side
+        topic subscription stays (other handlers may share it); a process
+        with zero handlers simply ignores the pushes."""
+        with self._sub_lock:
+            handlers = self._sub_handlers.get(topic)
+            if handlers and handler in handlers:
+                handlers.remove(handler)
+
     def publish(self, topic: str, data: Any):
         self.rpc.call("publish", {"topic": topic, "data": data})
 
@@ -738,7 +765,129 @@ class Client:
         self.check_bg()
         self._flush_put_batch()
         self._flush_submit_batch()
-        return self.rpc.call(method, body, timeout=timeout)
+        if method not in IDEMPOTENT_METHODS:
+            return self.rpc.call(method, body, timeout=timeout)
+        # Idempotent reads survive transient connection hiccups (head busy,
+        # socket reset during a head restart window) with jittered
+        # exponential backoff instead of surfacing the first failure.
+        # Timeouts are NOT retried: a stuck head would just multiply the
+        # caller's wait; only connection-level failures qualify.
+        import random
+
+        last: Optional[BaseException] = None
+        for attempt in range(IDEMPOTENT_RETRY_ATTEMPTS):
+            try:
+                return self.rpc.call(method, body, timeout=timeout)
+            except (ConnectionLost, ConnectionError, OSError) as e:
+                if isinstance(e, TimeoutError):
+                    raise
+                last = e
+                if attempt + 1 >= IDEMPOTENT_RETRY_ATTEMPTS:
+                    break
+                backoff = IDEMPOTENT_RETRY_BASE_S * (2 ** attempt)
+                time.sleep(backoff * (0.5 + random.random()))
+                if self.rpc.closed:
+                    # A dead RpcClient never heals on its own (sticky
+                    # `closed`): without a fresh connection the remaining
+                    # attempts would fail identically.
+                    self._try_reconnect()
+        raise last
+
+    def _try_reconnect(self) -> bool:
+        """Driver-only recovery from a lost head connection (e.g. a head
+        restart window): dial a fresh RpcClient, re-register, re-subscribe
+        pubsub topics, and swap it in.  Workers never reconnect — their
+        identity (worker records, in-flight tasks) died with the old
+        connection, and worker_main exits on connection loss.  Proxy
+        drivers don't either: their mode/session state is negotiated in
+        the initial register reply, and a silent re-register could flip
+        the head's view of the protocol mid-stream."""
+        if self.kind != "driver" or self.proxy:
+            return False
+        from . import schema as wire_schema
+
+        # One reconnector at a time: concurrent retry paths (user thread +
+        # autoscaler/serve poll threads) would each dial and register, and
+        # the loser's swap would close the winner's fresh connection —
+        # leaving a duplicate driver registration head-side whose
+        # disconnect fires job-scoped cleanup against live state.
+        with self._reconnect_lock:
+            if not self.rpc.closed:
+                return True  # another caller already healed the connection
+            return self._reconnect_locked(wire_schema)
+
+    def _reconnect_locked(self, wire_schema) -> bool:
+        rpc = None
+        try:
+            host, port = self.head_addr.rsplit(":", 1)
+            rpc = RpcClient(host, int(port), name=f"{self.kind}-rpc")
+            rpc.on_push("pubsub", self._on_pubsub)
+            rpc.on_push("object_free", self._on_object_free)
+            reply = rpc.call("register", {
+                "kind": self.kind, "pid": os.getpid(),
+                "protocol": wire_schema.PROTOCOL_VERSION,
+                # Same-process re-dial: lets the head un-retire this pid's
+                # cumulative metrics instead of double-counting them (and
+                # never confuse a recycled pid for a comeback).
+                "reconnect": True,
+            })
+            if reply.get("session") != self.session:
+                # A different session means the HEAD RESTARTED, not a
+                # network blip: this driver's puts and object refs live in
+                # the old session's store namespace and its node_id may be
+                # stale — a silent rebind would look healthy until the
+                # first object access hung.  Surface the outage instead.
+                rpc.close()
+                return False
+            with self._sub_lock:
+                topics = list(self._sub_handlers)
+            for topic in topics:
+                rpc.call("subscribe", {"topic": topic})
+        except Exception:
+            # A dial that got as far as registering left a live duplicate
+            # driver connection head-side: close it so its disconnect
+            # cleanup runs NOW (against a connection that owns nothing)
+            # rather than minutes later against this driver's live state —
+            # and so each failed attempt doesn't leak a socket + thread.
+            if rpc is not None:
+                try:
+                    rpc.close()
+                except Exception:
+                    pass
+            return False  # head still down: the caller's backoff continues
+        old, self.rpc = self.rpc, rpc
+        try:
+            old.close()  # stop the dead client's event-loop thread
+        except Exception:
+            pass
+        # The free-flusher thread exits when it observes a closed rpc; if it
+        # died during the outage window, object frees (and the batched
+        # put/submit safety-net flush) would silently stop forever.  The
+        # brief join drains a loop that already decided to exit but hasn't
+        # returned yet (its wakeup period is 0.5s).
+        flusher = getattr(self, "_free_flusher", None)
+        if flusher is not None and flusher is not threading.current_thread():
+            flusher.join(timeout=1.0)
+        if flusher is None or not flusher.is_alive():
+            self._free_flusher = threading.Thread(
+                target=self._free_flush_loop, daemon=True, name="free-flusher"
+            )
+            self._free_flusher.start()
+        # Reads work again, but the OLD connection's death already tore
+        # down job-scoped state head-side (non-detached placement groups,
+        # in-flight task ownership).  Say so loudly instead of letting a
+        # later hang be the first symptom.
+        import warnings
+
+        warnings.warn(
+            "ray_tpu driver reconnected to the head after a lost "
+            "connection; job-scoped state tied to the old connection "
+            "(non-detached placement groups, in-flight tasks) may have "
+            "been released",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return True
 
     def close(self):
         try:

@@ -268,6 +268,10 @@ class Head:
         # Counters/histograms of departed processes (see _retire_metrics):
         # cluster totals must stay monotonic across worker churn.
         self._metrics_retired: Dict[tuple, dict] = {}
+        # Per-pid retired contributions, so a RECONNECTED process (driver
+        # reconnect path) that re-reports its cumulative counters doesn't
+        # get double-counted against its own retired snapshot.
+        self._retired_by_pid: Dict[int, list] = {}
         # Cumulative store counters of departed NODES, same invariant.
         self._store_retired: Dict[str, float] = {}
         self._state_dirty = True  # persist once at startup when configured
@@ -323,7 +327,7 @@ class Head:
             "ping", "shutdown_cluster",
             "actor_restarting", "restore_object", "store_stats",
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
-            "node_health_ack", "node_stats", "span",
+            "node_health_ack", "node_stats", "node_drain", "span",
         ]:
             self.server.register(
                 name, _validated(name, getattr(self, f"h_{name}"))
@@ -834,6 +838,14 @@ class Head:
         conn.meta["kind"] = kind  # driver
         conn.meta["pid"] = body.get("pid")
         conn.meta["reader_node"] = self.local_node_id
+        if body.get("reconnect"):
+            # Same-process driver re-dial (client._try_reconnect): its
+            # cumulative counters were folded into the retired baseline at
+            # disconnect and are about to be re-reported live.  Mark the
+            # connection so the first metrics report un-retires them — an
+            # explicit marker, never pid heuristics (a recycled pid from an
+            # unrelated process must not decrement the baseline).
+            conn.meta["reconnected_pid"] = body.get("pid")
         return {
             "session": self.session,
             "node_id": self.local_node_id.binary() if self.local_node_id else b"",
@@ -1154,7 +1166,37 @@ class Head:
         """Per-process metric snapshots; the head keeps the latest rows per
         reporting pid and aggregates on read (reference: stats exported to
         the node metrics agent, src/ray/stats/metric_exporter.h)."""
-        self.metrics_by_pid[body["pid"]] = body["rows"]
+        pid = body["pid"]
+        stale = None
+        if conn.meta.get("reconnected_pid") == pid:
+            # Register-declared: only a driver that re-dialed with
+            # reconnect=True (same process, same cumulative counters) may
+            # un-retire its rows — a bare-pid match would let an unrelated
+            # process with a recycled/colliding pid permanently decrement
+            # the retired baseline.  The marker is consumed only once a
+            # retired snapshot actually exists: on a half-open connection
+            # the NEW conn's first report can land before the OLD conn's
+            # disconnect is processed (which is when _retire_metrics folds
+            # the rows in) — popping the marker early would leave that
+            # later-retired copy permanently double-counted.
+            stale = self._retired_by_pid.pop(pid, None)
+            if stale is not None:
+                conn.meta.pop("reconnected_pid", None)
+        if stale:
+            # The driver came back: its cumulative rows were folded into
+            # the retired baseline at disconnect and are about to be
+            # re-reported live — subtract the retired copy or every series
+            # it owns doubles.
+            for r in stale:
+                neg = dict(r)
+                neg["value"] = -r.get("value", 0)
+                if "sum" in r:
+                    neg["sum"] = -r["sum"]
+                    neg["count"] = -r.get("count", 0)
+                if r.get("buckets"):
+                    neg["buckets"] = [-b for b in r["buckets"]]
+                self._merge_metric_row(self._metrics_retired, neg)
+        self.metrics_by_pid[pid] = body["rows"]
         return {}
 
     def _sample_telemetry(self):
@@ -1216,9 +1258,13 @@ class Head:
         rows = self.metrics_by_pid.pop(pid, None)
         if not rows:
             return
-        for r in rows:
-            if r.get("kind") in ("counter", "histogram"):
-                self._merge_metric_row(self._metrics_retired, r)
+        kept = [r for r in rows if r.get("kind") in ("counter", "histogram")]
+        for r in kept:
+            self._merge_metric_row(self._metrics_retired, r)
+        if kept:
+            self._retired_by_pid[pid] = kept
+            while len(self._retired_by_pid) > 1000:  # bound: evict oldest
+                self._retired_by_pid.pop(next(iter(self._retired_by_pid)))
 
     def metrics_rows(self) -> List[dict]:
         """Aggregate across processes: counters/histogram counts sum, gauges
@@ -2210,6 +2256,37 @@ class Head:
         self.node_last_ack[NodeID(body["node_id"])] = time.monotonic()
         return {}
 
+    async def h_node_drain(self, conn, body):
+        """Announced preemption (spot/maintenance SIGTERM with a grace
+        window): the node daemon reports DRAINING before it goes away.  The
+        scheduler stops leasing onto the node immediately, and every
+        subscribed process (train sessions subscribe at worker setup) gets a
+        ``node_events`` drain notification so gangs can checkpoint inside
+        the grace window (reference: GcsNodeManager DrainNode + the
+        autoscaler's drain-before-terminate; TorchTitan-style graceful
+        drain on SIGTERM)."""
+        node_id = NodeID(body["node_id"])
+        grace_s = float(body.get("grace_s", 0.0))
+        marked = self.scheduler.mark_draining(node_id)
+        self._event("node_drain", node=node_id.hex(), grace_s=grace_s)
+        await self._publish("node_events", {
+            "event": "drain",
+            "node_id": node_id.hex(),
+            "grace_s": grace_s,
+        })
+        # Idle workers on a draining node have nothing to finish: shut them
+        # down now so the daemon (which exits early once its last worker is
+        # gone) doesn't sit out the full grace window for an idle node —
+        # the autoscaler's scale-down path stays fast.  Leased/actor
+        # workers keep running: they are what the grace window is FOR.
+        for w in list(self.workers.values()):
+            if w.node_id == node_id and w.state == IDLE and w.conn.alive:
+                try:
+                    await w.conn.push("shutdown", {})
+                except Exception:
+                    pass
+        return {"draining": marked}
+
     async def h_stream_item(self, conn, body):
         task_id = body["task_id"]
         idx = body["index"]
@@ -2743,7 +2820,8 @@ class Head:
                 {"node_id": nid.hex(), **info}
                 for nid, info in (
                     (n.node_id, {"resources": n.total, "available": n.available,
-                                 "alive": n.alive, "labels": n.labels,
+                                 "alive": n.alive, "draining": n.draining,
+                                 "labels": n.labels,
                                  "pending_spawns":
                                      self._spawn_pending.get(n.node_id, 0),
                                  "stats": self.node_stats.get(n.node_id)})

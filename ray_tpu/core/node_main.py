@@ -198,6 +198,15 @@ class NodeDaemon:
         self.node_id: Optional[NodeID] = None
         self.head: Optional[RpcClient] = None
         self._shutdown = threading.Event()
+        # Announced preemption (SIGTERM): grace window before this daemon
+        # actually exits.  During the window the node is DRAINING head-side
+        # (no new leases) but running workers keep going so gangs can
+        # checkpoint (reference: spot/maintenance preemption semantics —
+        # SIGTERM, then SIGKILL after the grace period).
+        self.drain_grace_s = float(os.environ.get("RT_DRAIN_GRACE_S", "5"))
+        self._drain_requested = False
+        self._drain_deadline: Optional[float] = None
+        self._drain_min_wait = 1.0
 
     def start(self):
         port = self.server_thread.start()
@@ -318,7 +327,50 @@ class NodeDaemon:
         except (FileNotFoundError, MemoryError):
             pass
 
+    # ------------------------------------------------------------- draining
+
+    def request_drain(self):
+        """SIGTERM handler body: flag only.  The RPC announcing the drain
+        runs from the main loop — a signal handler interrupting a call that
+        holds the rpc client's non-reentrant lock must not re-enter it."""
+        self._drain_requested = True
+
+    def _begin_drain(self):
+        """Report DRAINING to the head, keep serving for the grace window,
+        then exit through the normal shutdown path (the head's disconnect
+        handling does node-death cleanup)."""
+        if self._drain_deadline is not None:
+            return  # second SIGTERM: already draining
+        self._drain_deadline = time.monotonic() + self.drain_grace_s
+        # Zero workers at drain time: nothing can need the grace window —
+        # just a short linger so the announce RPC flushes (the early-exit
+        # check in run() uses this floor).
+        self._prune_worker_pids()
+        had_workers = bool(self.worker_pids) or any(
+            p.poll() is None for p in self.worker_procs
+        )
+        self._drain_min_wait = 1.0 if had_workers else 0.3
+        try:
+            self.head.call_async("node_drain", {
+                "node_id": self.node_id.binary(),
+                "grace_s": self.drain_grace_s,
+            })
+        except Exception:
+            pass  # head gone: nothing to announce, just run out the grace
+
     # ------------------------------------------------------------------ loop
+
+    def _prune_worker_pids(self):
+        """Drop zygote-forked worker pids whose process is gone (orphans
+        reaped by init): a stale pid could be recycled by an unrelated
+        process and must never be signalled at shutdown."""
+        for pid in list(self.worker_pids):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                self.worker_pids.discard(pid)
+            except PermissionError:
+                self.worker_pids.discard(pid)  # recycled: not ours
 
     def _report_stats(self):
         """Push this node's resource view to the head: store pressure, host
@@ -349,6 +401,23 @@ class NodeDaemon:
     def run(self):
         ticks = 0
         while not self._shutdown.wait(timeout=0.2):
+            if self._drain_requested and self._drain_deadline is None:
+                self._begin_drain()
+            if self._drain_deadline is not None:
+                if time.monotonic() >= self._drain_deadline:
+                    break  # grace window over: the preemption lands now
+                # Early exit: once the last worker process is gone there is
+                # nothing left to grace (the head shuts down IDLE workers
+                # at drain, so an idle node clears out in ~a second while a
+                # gang-hosting node runs its full window).
+                self._prune_worker_pids()
+                live_procs = [p for p in self.worker_procs
+                              if p.poll() is None]
+                if (not self.worker_pids and not live_procs
+                        and time.monotonic() >=
+                        self._drain_deadline - self.drain_grace_s
+                        + self._drain_min_wait):
+                    break
             self.store.tick()  # cooled freed segments -> warm pool
             # Reap exited worker processes so they don't zombie.
             for p in self.worker_procs:
@@ -356,16 +425,7 @@ class NodeDaemon:
             ticks += 1
             if ticks % 10 == 0:
                 self._report_stats()
-                # Prune exited zygote-forked workers (orphans reaped by
-                # init): a stale pid could be recycled by an unrelated
-                # process and must never be signalled at shutdown.
-                for pid in list(self.worker_pids):
-                    try:
-                        os.kill(pid, 0)
-                    except ProcessLookupError:
-                        self.worker_pids.discard(pid)
-                    except PermissionError:
-                        self.worker_pids.discard(pid)  # recycled: not ours
+                self._prune_worker_pids()
         for p in self.worker_procs:
             if p.poll() is None:
                 p.terminate()
@@ -397,6 +457,9 @@ def main():
 
     faulthandler.register(signal.SIGUSR1)
     daemon = NodeDaemon()
+    # Preemption notice: SIGTERM starts a graceful drain instead of killing
+    # the daemon outright (SIGKILL remains the crash-simulation path).
+    signal.signal(signal.SIGTERM, lambda *_: daemon.request_drain())
     daemon.start()
     daemon.run()
 

@@ -71,10 +71,20 @@ class NodeState:
     available: ResourceDict
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     alive: bool = True
+    # Announced preemption (SIGTERM with a grace window): the node is still
+    # up — running work may finish and checkpoint — but no NEW leases or
+    # bundle reservations land on it (reference: ray.util.state node DRAINING
+    # via DrainNode; autoscaler v2 drains before terminating).
+    draining: bool = False
     # Free TPU chip IDs on this host.  The float "TPU" resource governs
     # *admission*; this pool assigns the concrete device indices a granted
     # task may see (reference: tpu.py:155 TPU_VISIBLE_CHIPS isolation).
     tpu_free: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def schedulable(self) -> bool:
+        """Eligible for NEW placements (alive and not being drained)."""
+        return self.alive and not self.draining
 
     def utilization(self) -> float:
         worst = 0.0
@@ -150,6 +160,16 @@ class ClusterScheduler:
             node.tpu_free.extend(c for c in chips if c not in node.tpu_free)
             node.tpu_free.sort()
 
+    def mark_draining(self, node_id: NodeID) -> bool:
+        """Announced preemption: stop NEW placements on the node while its
+        grace window runs.  Running work (and its resources) is untouched —
+        the node-death path reclaims everything when the daemon exits."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return False
+        node.draining = True
+        return True
+
     def remove_node(self, node_id: NodeID) -> List[PlacementGroupID]:
         """Drop a node.  Returns ids of placement groups that lost bundles
         (the control plane retries `reschedule_lost_bundles` for them —
@@ -182,7 +202,8 @@ class ClusterScheduler:
         used = {b.node_id for b in pg.bundles if b.node_id is not None}
         placed: List[Tuple[Bundle, NodeID]] = []
         avail = {
-            nid: dict(n.available) for nid, n in self.nodes.items() if n.alive
+            nid: dict(n.available)
+            for nid, n in self.nodes.items() if n.schedulable
         }
         for b in lost:
             order = sorted(
@@ -225,13 +246,13 @@ class ClusterScheduler:
 
         if strategy.kind == "node_affinity":
             node = self.nodes.get(strategy.node_id)
-            if node and node.alive and _fits(node.available, resources):
+            if node and node.schedulable and _fits(node.available, resources):
                 return node.node_id
             if strategy.soft:
                 return self._pick_hybrid(resources)
             return None
 
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values() if n.schedulable]
         if strategy.kind == "spread":
             # Round-robin over feasible nodes
             # (reference: scheduling/policy/spread_scheduling_policy.h).
@@ -251,7 +272,7 @@ class ClusterScheduler:
         feasible = [
             n
             for n in self.nodes.values()
-            if n.alive and _fits(n.available, resources)
+            if n.schedulable and _fits(n.available, resources)
         ]
         if not feasible:
             return None
@@ -278,8 +299,15 @@ class ClusterScheduler:
         )
         for i in indices:
             b = pg.bundles[i]
-            if b.node_id is not None and _fits(b.available, resources):
-                return b.node_id
+            if b.node_id is None or not _fits(b.available, resources):
+                continue
+            node = self.nodes.get(b.node_id)
+            if node is None or not node.schedulable:
+                # Draining/dead host: starting NEW work there would be
+                # killed at grace-window end.  The task pends; the bundle
+                # re-places via reschedule_lost_bundles when the node dies.
+                continue
+            return b.node_id
         return None
 
     def acquire(
@@ -421,7 +449,7 @@ class ClusterScheduler:
         avail = {
             nid: dict(n.available)
             for nid, n in self.nodes.items()
-            if n.alive
+            if n.schedulable
         }
         placed: List[NodeID] = []
         strat = pg.strategy
@@ -487,6 +515,7 @@ class ClusterScheduler:
                     "available": n.available,
                     "labels": n.labels,
                     "alive": n.alive,
+                    "draining": n.draining,
                 }
                 for n in self.nodes.values()
             },

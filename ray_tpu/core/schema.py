@@ -65,6 +65,7 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "task_unblocked": (("task_id", _BYTES),),
     "node_health_ack": (("node_id", _BYTES),),
     "node_stats": (("node_id", _BYTES),),
+    "node_drain": (("node_id", _BYTES),),
     "span": (("trace_id", str), ("span_id", str), ("name", str)),
     "restore_object": (("object_id", _BYTES),),
 }

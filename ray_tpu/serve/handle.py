@@ -18,6 +18,27 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 
+# Replica-death retry budget: total attempts a request gets when its replica
+# dies underneath it (rolling update, crash) before the error surfaces.
+# Shared by the unary path (DeploymentResponse.result) and the streaming
+# path (DeploymentResponseGenerator, pre-first-item only — a mid-stream
+# replica death is stateful and must surface).  Every consumed retry counts
+# into ``ray_tpu_serve_replica_retries_total`` (tagged by path).
+REPLICA_RETRY_BUDGET = 3
+
+
+def _count_replica_retry(path: str) -> None:
+    from ..util.metrics import get_counter
+
+    try:
+        get_counter(
+            "ray_tpu_serve_replica_retries_total",
+            "Requests re-routed after a replica death",
+            tag_keys=("path",),
+        ).inc(1, tags={"path": path})
+    except Exception:
+        pass  # metrics must never fail a request
+
 
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference: serve/handle.py
@@ -34,12 +55,14 @@ class DeploymentResponse:
         from ..exceptions import ActorDiedError, WorkerCrashedError
 
         try:
-            for attempt in range(3):
+            for attempt in range(REPLICA_RETRY_BUDGET):
                 try:
                     return ray_tpu.get(self._ref, timeout=timeout)
                 except (ActorDiedError, WorkerCrashedError):
-                    if self._retry is None or attempt == 2:
+                    if (self._retry is None
+                            or attempt == REPLICA_RETRY_BUDGET - 1):
                         raise
+                    _count_replica_retry("unary")
                     time.sleep(0.2 * (attempt + 1))
                     self._ref = self._retry()
         finally:
@@ -57,12 +80,14 @@ class DeploymentResponseGenerator:
     serve/handle.py DeploymentResponseGenerator over an
     ObjectRefGenerator).  Buffering is consumer-side one-item-at-a-time;
     produced-but-unconsumed items wait in the object store (spill-bounded),
-    never in this process.  No mid-stream replica retry: a stream is
-    stateful, so a replica death surfaces to the caller."""
+    never in this process.  The REPLICA_RETRY_BUDGET applies only BEFORE
+    the first item is yielded (the request is still stateless then); a
+    mid-stream replica death is stateful and surfaces to the caller."""
 
-    def __init__(self, ref_gen, done_cb=None):
+    def __init__(self, ref_gen, done_cb=None, retry=None):
         self._gen = ref_gen
         self._done_cb = done_cb
+        self._retry = retry
 
     def _release(self):
         if self._done_cb is not None:
@@ -70,9 +95,25 @@ class DeploymentResponseGenerator:
             cb()
 
     def __iter__(self):
+        from ..exceptions import ActorDiedError, WorkerCrashedError
+
         try:
-            for ref in self._gen:
-                yield ray_tpu.get(ref)
+            yielded = False
+            attempt = 0
+            while True:
+                try:
+                    for ref in self._gen:
+                        yield ray_tpu.get(ref)
+                        yielded = True
+                    return
+                except (ActorDiedError, WorkerCrashedError):
+                    attempt += 1
+                    if (yielded or self._retry is None
+                            or attempt >= REPLICA_RETRY_BUDGET):
+                        raise
+                    _count_replica_retry("streaming")
+                    time.sleep(0.2 * attempt)
+                    self._gen = self._retry()
         finally:
             self._release()
 
@@ -203,9 +244,6 @@ class DeploymentHandle:
                 state["idx"] = idx
             ref = submit(replica)
 
-        if self.stream:
-            return DeploymentResponseGenerator(ref, done)
-
         def retry():
             self._refresh(force=True)
             with self._lock:
@@ -225,11 +263,10 @@ class DeploymentHandle:
                     )
                 self._local_load[i] = self._local_load.get(i, 0) + 1
                 state["idx"] = i
-            return rep.handle_request.remote(
-                self.method, args, kwargs,
-                model_id=self.multiplexed_model_id,
-            )
+            return submit(rep)
 
+        if self.stream:
+            return DeploymentResponseGenerator(ref, done, retry)
         return DeploymentResponse(ref, done, retry)
 
     def __reduce__(self):

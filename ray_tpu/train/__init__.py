@@ -26,6 +26,7 @@ from .session import (
     get_dataset_shard,
     get_mesh,
     report,
+    should_checkpoint,
 )
 from .telemetry import TrainTelemetry
 from .trainer import DataParallelTrainer, JaxTrainer, TrainingFailedError
@@ -35,7 +36,7 @@ __all__ = [
     "AsyncCheckpointWriter",
     "RunConfig", "ScalingConfig", "FailureConfig", "CheckpointConfig",
     "Result", "report", "get_checkpoint", "get_context", "get_dataset_shard",
-    "get_mesh",
+    "get_mesh", "should_checkpoint",
     "DataParallelTrainer", "JaxTrainer", "TrainingFailedError",
     "telemetry", "TrainTelemetry",
 ]

@@ -79,6 +79,31 @@ def load_pytree(directory: str, name: str = "state") -> Any:
         return pickle.load(f)
 
 
+def pack_directory(directory: str) -> bytes:
+    """Flatten a checkpoint directory into one blob ({relpath: bytes},
+    pickle-5) — the wire/object-store form of an in-memory checkpoint
+    replica (see CheckpointConfig.memory_ckpt_every_k)."""
+    files: Dict[str, bytes] = {}
+    for root, _, names in os.walk(directory):
+        for name in names:
+            path = os.path.join(root, name)
+            with open(path, "rb") as f:
+                files[os.path.relpath(path, directory)] = f.read()
+    return pickle.dumps(files, protocol=5)
+
+
+def unpack_directory(blob: bytes, directory: str) -> str:
+    """Materialize a pack_directory blob back into a directory."""
+    files = pickle.loads(blob)
+    for rel, data in files.items():
+        path = os.path.join(directory, rel)
+        os.makedirs(os.path.dirname(path) or directory, exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+    os.makedirs(directory, exist_ok=True)  # empty checkpoints stay loadable
+    return directory
+
+
 class AsyncCheckpointWriter:
     """Overlapped checkpoint saves: ``save()`` snapshots the pytree to host
     memory synchronously (cheap: the D2H DMA is kicked with

@@ -21,6 +21,16 @@ class ScalingConfig:
     devices form a mesh (reference: air/config.py ScalingConfig)."""
 
     num_workers: int = 1
+    # Elastic floor: after a failure that shrank the cluster (e.g. a
+    # preempted node not yet replaced), the trainer re-forms the gang at the
+    # largest feasible world size within [min_workers, num_workers] instead
+    # of waiting for full capacity, and grows back toward num_workers on a
+    # later restart once the autoscaler backfills.  None = not elastic
+    # (always num_workers — the reference's fixed-size semantics).
+    min_workers: Optional[int] = None
+    # How long a restart may wait for at least min_workers' worth of
+    # capacity to appear before giving up (elastic gangs only).
+    elastic_wait_s: float = 30.0
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
     # Mesh built in every worker at setup (exposed via
@@ -64,6 +74,22 @@ class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"  # "max" | "min"
+    # Peer-replicated in-memory checkpoints: every K-th reported checkpoint,
+    # each rank also pushes its host snapshot into a surviving peer's object
+    # store (ring-neighbor, different-node preferred).  After a failure the
+    # new gang restores from the freshest in-memory copy when it is newer
+    # than the last disk write — recovery costs seconds, not a checkpoint
+    # interval (TorchTitan-style replicated in-memory checkpoints).
+    # OPT-IN (None disables): replication packs the whole checkpoint into
+    # host memory and does a confirmed cross-node push inside the report
+    # path — a price multi-GB checkpoints must choose, not inherit.
+    memory_ckpt_every_k: Optional[int] = None
+    # Disk-persistence cadence among reported checkpoints: the trainer
+    # registers every K-th reported checkpoint into durable storage (drain
+    # saves always persist).  With frequent cheap host snapshots + sparse
+    # disk writes, an un-announced failure recovers from the in-memory
+    # replicas at a step strictly later than the last disk checkpoint.
+    disk_ckpt_every_k: int = 1
 
 
 @dataclasses.dataclass

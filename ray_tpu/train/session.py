@@ -46,6 +46,28 @@ class TrainSession:
         self.consumed = threading.Semaphore(0)
         self.step = 0
         self.finished = False
+        # Preemption drain: the DRIVER observes node drains and piggybacks
+        # the signal on the lockstep ack (see ack(should_checkpoint=True)),
+        # so every rank's should_checkpoint() flips at the same round
+        # boundary — a gang-wide same-step drain save (per-rank pubsub
+        # delivery would skew ranks by a round and persist partial-rank
+        # checkpoints).  Reporting a checkpoint clears the flag.
+        self._drain_pending = False
+        # Peer-replicated in-memory checkpoints: ring successor's actor
+        # handle + cadence (set by WorkerGroup after all ranks are placed),
+        # and this process's view of replicated snapshots —
+        # {rank: [(step, ObjectRef-of-packed-dir), ...]} holding its OWN
+        # latest snapshots plus any peer snapshots pushed to it.  The last
+        # TWO per rank are kept: lockstep reporting bounds rank skew to one
+        # round, so two generations guarantee a common step exists across
+        # the gang even when a node dies mid-round.
+        self._peer_handle = None
+        self._memory_every_k: Optional[int] = None
+        self._ckpt_count = 0
+        # Guarded by _snapshots_lock: pushed to by the peer's RPC thread
+        # while the train loop replicates and the driver collects.
+        self.memory_snapshots: Dict[int, list] = {}
+        self._snapshots_lock = threading.Lock()
         # Goodput accounting (train/telemetry.py): report() derives step
         # time / tokens-per-sec / MFU per round and both sets the
         # ray_tpu_train_* gauges and merges the numbers into the reported
@@ -62,6 +84,61 @@ class TrainSession:
             self._telemetry = TrainTelemetry(rank=self.world_rank)
         return self._telemetry
 
+    # ---- drain / in-memory replication wiring -------------------------------
+
+    def should_checkpoint(self) -> bool:
+        """True when a preemption drain was announced and no checkpoint has
+        been reported since — the user loop should save NOW, ahead of its
+        periodic cadence (reference shape: TorchTitan/elastic trainers
+        checkpoint on SIGTERM notice)."""
+        return self._drain_pending
+
+    def configure_memory_checkpoints(self, peer_handle,
+                                     every_k: Optional[int]) -> None:
+        self._peer_handle = peer_handle
+        self._memory_every_k = every_k
+
+    def remember_snapshot(self, rank: int, step: int, ref) -> None:
+        """Record an in-memory snapshot handle for ``rank``, keeping the
+        last two generations (older refs drop → their store segments free)."""
+        with self._snapshots_lock:
+            entries = self.memory_snapshots.setdefault(rank, [])
+            entries.append((step, ref))
+            del entries[:-2]
+
+    def snapshot_view(self) -> Dict[int, list]:
+        """Consistent copy of the replica table (safe against concurrent
+        peer pushes)."""
+        with self._snapshots_lock:
+            return {r: list(v) for r, v in self.memory_snapshots.items()}
+
+    def _replicate_checkpoint(self, staged_dir: str) -> None:
+        """Push this rank's host snapshot into the object store (own node)
+        and to its ring peer's store, so a new gang can restore from memory
+        after this rank's node dies.  Best-effort: replication must never
+        fail a training round."""
+        import ray_tpu
+
+        from .checkpoint import pack_directory
+
+        blob = pack_directory(staged_dir)
+        # Own copy: survives THIS PROCESS dying (worker crash) as long as
+        # the node's store daemon lives; the driver re-owns it at collection.
+        self.remember_snapshot(self.world_rank, self.step, ray_tpu.put(blob))
+        if self._peer_handle is not None:
+            # Peer copy: survives this NODE dying.  CONFIRMED, not fire-and-
+            # forget: the trainer skips the disk write on the strength of
+            # this replica, so an unacknowledged push must surface here
+            # (the caller then reports memory_replicated=False and the
+            # round persists to disk instead).  No await cycle: the peer's
+            # handler only does a local put on its own concurrency slot.
+            ray_tpu.get(
+                self._peer_handle.store_peer_snapshot.remote(
+                    self.world_rank, self.step, blob
+                ),
+                timeout=30.0,
+            )
+
     # ---- called from user train loop ----------------------------------------
 
     def report(self, metrics: Dict[str, Any],
@@ -69,6 +146,7 @@ class TrainSession:
         self.step += 1
         metrics = self._augment_metrics(dict(metrics))
         persisted = None
+        replicated = False
         if checkpoint is not None:
             # Stage the worker's checkpoint under the trial dir so it outlives
             # the user's temp directory.
@@ -78,9 +156,23 @@ class TrainSession:
             )
             shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
             persisted = dest
+            self._ckpt_count += 1
+            drain_save = self._drain_pending
+            self._drain_pending = False
+            if self._memory_every_k is not None and (
+                    drain_save
+                    or (self._ckpt_count % self._memory_every_k) == 0):
+                try:
+                    self._replicate_checkpoint(dest)
+                    replicated = True
+                except Exception:
+                    pass  # replication is best-effort by design
+        else:
+            drain_save = False
         self.result_queue.put(
             {"metrics": metrics, "checkpoint_dir": persisted,
-             "step": self.step, "rank": self.world_rank}
+             "step": self.step, "rank": self.world_rank,
+             "drain": drain_save, "memory_replicated": replicated}
         )
         # Lockstep with the driver (reference behavior: session.report blocks
         # until the round is processed).
@@ -131,7 +223,14 @@ class TrainSession:
         except queue.Empty:
             return None
 
-    def ack(self):
+    def ack(self, should_checkpoint: bool = False):
+        """Driver's round acknowledgment.  ``should_checkpoint=True``
+        carries a drain notice: set BEFORE the semaphore release so the
+        rank observes it on its very next should_checkpoint() poll — and,
+        because every rank's ack for a round carries the same flag, the
+        whole gang saves the SAME step."""
+        if should_checkpoint:
+            self._drain_pending = True
         self.consumed.release()
 
 
@@ -175,6 +274,13 @@ def get_mesh():
     """The jax.sharding.Mesh built from ScalingConfig.mesh for this worker
     (None when the trainer was not configured with a mesh)."""
     return get_session().mesh
+
+
+def should_checkpoint() -> bool:
+    """True when the cluster announced a preemption (node drain) and this
+    worker should checkpoint NOW, ahead of its periodic cadence.  Cleared
+    by the next report() that carries a checkpoint."""
+    return get_session().should_checkpoint()
 
 
 class TrainContext:

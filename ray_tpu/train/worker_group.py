@@ -50,10 +50,11 @@ def _dumps_by_value(fn) -> bytes:
                 pass
 
 
-@ray_tpu.remote(max_concurrency=4)
+@ray_tpu.remote(max_concurrency=8)
 class TrainWorker:
-    """One rank of the gang.  max_concurrency lets poll()/ack() run while the
-    train loop blocks inside run()."""
+    """One rank of the gang.  max_concurrency lets poll()/ack() — and peer
+    snapshot pushes / failure-time snapshot collection — run while the train
+    loop blocks inside run()."""
 
     def __init__(self, rank: int, world_size: int, trial_dir: str):
         self.rank = rank
@@ -105,7 +106,31 @@ class TrainWorker:
             from ..parallel.mesh import make_mesh
 
             self.session.mesh = make_mesh(mesh_config)
-        return self.rank
+        # Rank + host identity: the driver uses node ids to pick each rank's
+        # replication peer on a DIFFERENT node where possible.
+        return {"rank": self.rank, "node_id": os.environ.get("RT_NODE_ID", "")}
+
+    def configure_memory_checkpoints(self, peer_handle, every_k):
+        """Wire this rank's in-memory checkpoint replication: snapshots go
+        to the local object store and to ``peer_handle``'s store every K-th
+        reported checkpoint (and always on a drain save)."""
+        self.session.configure_memory_checkpoints(peer_handle, every_k)
+        return True
+
+    def store_peer_snapshot(self, rank: int, step: int, blob: bytes):
+        """Receive a peer rank's packed checkpoint: pin it in THIS node's
+        object store and remember the handle (last two generations; dropped
+        refs free the older replicas)."""
+        import ray_tpu
+
+        self.session.remember_snapshot(rank, step, ray_tpu.put(blob))
+        return True
+
+    def memory_snapshots(self):
+        """{rank: [(step, ObjectRef), ...]} of every in-memory snapshot this
+        rank holds (its own + replicas pushed by peers).  Serializing the
+        refs to the driver increfs them, so the blobs outlive this worker."""
+        return self.session.snapshot_view()
 
     def run(self, fn_blob: bytes, config: Optional[dict]):
         """Execute the user train loop; always ends with a 'done' sentinel —
@@ -131,8 +156,8 @@ class TrainWorker:
     def poll(self, timeout: float = 600.0):
         return self.session.next_result(timeout=timeout)
 
-    def ack(self):
-        self.session.ack()
+    def ack(self, should_checkpoint: bool = False):
+        self.session.ack(should_checkpoint)
         return True
 
     def _init_collective(self, world_size, rank, group_name):
@@ -145,12 +170,20 @@ class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
                  trial_dir: str, placement_strategy: str = "PACK",
                  mesh_config=None, jax_distributed: bool = False,
-                 runtime_env: Optional[dict] = None):
+                 runtime_env: Optional[dict] = None,
+                 memory_ckpt_every_k: Optional[int] = None):
         self.num_workers = num_workers
         self.trial_dir = trial_dir
         self.mesh_config = mesh_config
         self.jax_distributed = jax_distributed
         self.runtime_env = runtime_env
+        # <=0 means disabled, same as None (0 would ZeroDivision in the
+        # session's cadence check; negative cadences are meaningless).
+        self.memory_ckpt_every_k = (
+            memory_ckpt_every_k
+            if memory_ckpt_every_k and memory_ckpt_every_k > 0 else None
+        )
+        self.gang_nodes: set = set()  # filled by setup()
         self.gang_id = os.urandom(4).hex()
         self.pg = None
         if num_workers > 1:
@@ -204,7 +237,33 @@ class WorkerGroup:
             )
             for i, w in enumerate(self.workers)
         ]
-        return ray_tpu.get(refs)
+        infos = ray_tpu.get(refs)
+        # Which cluster nodes host this gang (hex ids) — the trainer
+        # filters drain notices against this set.
+        self.gang_nodes = {i.get("node_id", "") for i in infos} - {""}
+        if self.memory_ckpt_every_k is not None and self.num_workers > 1:
+            self._wire_replication_peers(infos)
+        return infos
+
+    def _wire_replication_peers(self, infos: List[dict]):
+        """Give each rank a replication peer: the nearest ring successor on
+        a DIFFERENT node when one exists (with PACK placement, consecutive
+        ranks co-locate — a same-node ring neighbor would die with the rank
+        it is supposed to back up), else the plain ring successor."""
+        nodes = {i["rank"]: i.get("node_id", "") for i in infos}
+        n = self.num_workers
+        refs = []
+        for r in range(n):
+            peer = (r + 1) % n
+            for off in range(1, n):
+                cand = (r + off) % n
+                if nodes.get(cand) and nodes.get(cand) != nodes.get(r):
+                    peer = cand
+                    break
+            refs.append(self.workers[r].configure_memory_checkpoints.remote(
+                self.workers[peer], self.memory_ckpt_every_k
+            ))
+        ray_tpu.get(refs)
 
     def start_training(self, fn: Callable, config: Optional[dict]):
         blob = _dumps_by_value(fn)
@@ -220,11 +279,61 @@ class WorkerGroup:
             timeout=timeout + 60,
         )
 
-    def ack_all(self, ranks: Optional[List[int]] = None):
+    def ack_all(self, ranks: Optional[List[int]] = None,
+                should_checkpoint: bool = False):
+        """Release the round's lockstep.  ``should_checkpoint=True`` relays
+        a drain notice to every acked rank at the same round boundary."""
         targets = (
             self.workers if ranks is None else [self.workers[r] for r in ranks]
         )
-        ray_tpu.get([w.ack.remote() for w in targets])
+        ray_tpu.get([w.ack.remote(should_checkpoint) for w in targets])
+
+    def collect_memory_snapshots(self, timeout: float = 5.0):
+        """Gather in-memory checkpoint replicas from the surviving workers
+        after a gang failure (call BEFORE shutdown()).  Returns
+        ``(step, {rank: packed_dir_blob})`` for the newest step with full
+        rank coverage, or None when no complete in-memory set survived
+        (e.g. consecutive co-located ranks died with their replicas)."""
+        import time as _time
+
+        avail: Dict[int, Dict[int, Any]] = {}  # rank -> {step: ref}
+        # Fan out first, then collect against ONE shared deadline: dead
+        # ranks burn the timeout concurrently instead of serially stalling
+        # the recovery path (each get charges only the time remaining).
+        calls = [w.memory_snapshots.remote() for w in self.workers]
+        deadline = _time.monotonic() + timeout
+        for ref in calls:
+            try:
+                snaps = ray_tpu.get(
+                    ref, timeout=max(0.2, deadline - _time.monotonic())
+                )
+            except Exception:
+                continue  # dead or unreachable rank: its peers cover it
+            for rank, entries in snaps.items():
+                for step, ref in entries:
+                    avail.setdefault(rank, {})[step] = ref
+        if len(avail) < self.num_workers:
+            return None  # some rank left no surviving replica at all
+        # Newest step EVERY rank has a snapshot for (ranks may be one round
+        # apart when a node dies mid-round; two kept generations guarantee
+        # an intersection when replication ran on consecutive rounds).
+        common = set.intersection(
+            *(set(steps) for steps in avail.values())
+        )
+        if not common:
+            return None
+        best = max(common)
+        blobs: Dict[int, bytes] = {}
+        deadline = _time.monotonic() + timeout
+        for rank in range(self.num_workers):
+            try:
+                blobs[rank] = ray_tpu.get(
+                    avail[rank][best],
+                    timeout=max(0.2, deadline - _time.monotonic()),
+                )
+            except Exception:
+                return None  # replica's store node died too
+        return best, blobs
 
     def shutdown(self):
         for w in self.workers:

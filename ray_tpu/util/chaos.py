@@ -136,6 +136,78 @@ class NodeKiller:
         self.stop()
 
 
+class PreemptionInjector:
+    """Announced preemptions: SIGTERMs random non-head node daemons of a
+    ``cluster_utils.Cluster`` on a cadence, leaving each node its drain
+    grace window (vs NodeKiller's instant kill).  Models spot/maintenance
+    preemption — the dominant real-world TPU failure: the node reports
+    DRAINING, training gangs get the should_checkpoint() signal, and the
+    node dies only after the grace period.
+
+    ``delay_s`` postpones the first preemption (let the workload reach
+    steady state); ``max_preemptions`` bounds the blast radius so a soak
+    can assert recovery rather than starve the cluster.
+    """
+
+    def __init__(self, cluster, interval_s: float = 5.0, seed: int = 0,
+                 max_preemptions: Optional[int] = 1,
+                 delay_s: float = 0.0):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.delay_s = delay_s
+        self.max_preemptions = max_preemptions
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.preemptions = 0
+        self.preempted: list = []  # NodeHandles, in preemption order
+
+    def preempt_one(self) -> bool:
+        """Preempt one random remaining node now.  Returns False when the
+        cluster has no non-head nodes left."""
+        nodes = list(getattr(self.cluster, "nodes", []) or [])
+        if not nodes:
+            return False
+        victim = self._rng.choice(nodes)
+        try:
+            self.cluster.preempt_node(victim)
+        except Exception:
+            return False
+        self.preemptions += 1
+        self.preempted.append(victim)
+        return True
+
+    def _loop(self):
+        if self.delay_s and self._stop.wait(self.delay_s):
+            return
+        while True:
+            if (self.max_preemptions is not None
+                    and self.preemptions >= self.max_preemptions):
+                return
+            self.preempt_one()
+            if self._stop.wait(self.interval_s):
+                return
+
+    def start(self) -> "PreemptionInjector":
+        self._thread = threading.Thread(
+            target=self._loop, name="preemption-injector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return self.preemptions
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
 def run_under_chaos(fn, *, interval_s: float = 0.5, timeout_s: float = 60.0,
                     seed: int = 0):
     """Run ``fn()`` while a WorkerKiller fires; returns (result, kills).

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Chaos soak: run the fault-injection test subset N times with rotating
+# seeds and fail on ANY flake.  The chaos subset is everything marked
+# `chaos` (see pyproject.toml markers) plus the kill-cadence tests in
+# tests/test_chaos.py — the tests that exercise preemption drains,
+# in-memory checkpoint recovery, and elastic gang resize.
+#
+# Usage:
+#   scripts/chaos_soak.sh [N]          # default N=5
+#   CHAOS_PYTEST_ARGS="-k drain" scripts/chaos_soak.sh 10
+#
+# Rotating seeds: each iteration exports RT_CHAOS_SEED=<iter>, which the
+# chaos tests feed to their PreemptionInjector / victim RNGs, so every
+# pass kills a different node/worker mix.
+set -u -o pipefail
+
+N="${1:-5}"
+cd "$(dirname "$0")/.."
+
+fails=0
+for i in $(seq 1 "$N"); do
+    echo "=== chaos soak iteration $i/$N (RT_CHAOS_SEED=$i) ==="
+    if ! env JAX_PLATFORMS=cpu RT_CHAOS_SEED="$i" \
+        timeout -k 10 600 python -m pytest -q \
+        -m chaos tests/test_fault_tolerance.py tests/test_chaos.py \
+        -p no:cacheprovider -p no:randomly \
+        ${CHAOS_PYTEST_ARGS:-}; then
+        echo "!!! chaos soak FAILED on iteration $i (seed $i)"
+        fails=$((fails + 1))
+    fi
+done
+
+if [ "$fails" -gt 0 ]; then
+    echo "chaos soak: $fails/$N iterations flaked"
+    exit 1
+fi
+echo "chaos soak: $N/$N iterations green"

@@ -21,9 +21,13 @@ def rt():
     ray_tpu.shutdown()
 
 
+@pytest.mark.chaos
 def test_retriable_work_survives_worker_chaos(rt):
     """Tasks with retries complete while a WorkerKiller shoots busy
-    workers (reference: chaos_test pattern — kill cadence under load)."""
+    workers (reference: chaos_test pattern — kill cadence under load).
+    The seed rotates under scripts/chaos_soak.sh via RT_CHAOS_SEED."""
+    import os
+
     from ray_tpu.util.chaos import WorkerKiller
 
     @ray_tpu.remote(max_retries=10)
@@ -31,7 +35,8 @@ def test_retriable_work_survives_worker_chaos(rt):
         time.sleep(0.25)
         return i * 2
 
-    with WorkerKiller(interval_s=0.3, seed=1) as killer:
+    seed = int(os.environ.get("RT_CHAOS_SEED", "1"))
+    with WorkerKiller(interval_s=0.3, seed=seed) as killer:
         results = ray_tpu.get([slow.remote(i) for i in range(12)],
                               timeout=120)
     assert results == [i * 2 for i in range(12)]

@@ -74,6 +74,7 @@ class Rank:
         return float(part[0])
 
 
+@pytest.mark.slow  # world=16 actor gang: ~20s on a loaded CPU host
 def test_tree_collectives_world16(rt):
     """world=16 gang: results correct on every rank and total KV puts stay
     within the tree bound — far below the old all-to-all O(world^2)."""

@@ -435,6 +435,7 @@ def test_actor_pool_requires_class(rt):
             lambda b: b, compute=ActorPoolStrategy(size=1))
 
 
+@pytest.mark.slow  # multi-round range exchange: ~25s on a loaded CPU host
 def test_distributed_sort_range_exchange(rt):
     """Sort runs as sample -> range-partition -> per-range sort: output
     keeps multiple blocks (nothing gathered the whole dataset) and is
@@ -452,6 +453,7 @@ def test_distributed_sort_range_exchange(rt):
     np.testing.assert_array_equal(got, np.sort(vals)[::-1])
 
 
+@pytest.mark.slow  # all-to-all shuffle: ~15s on a loaded CPU host
 def test_random_shuffle_partition_exchange(rt):
     """Shuffle is a partition/merge exchange: multiset preserved, output
     differs from input order, every output block mixes source blocks, and

@@ -23,6 +23,7 @@ def cluster():
     c.shutdown()
 
 
+@pytest.mark.slow  # two-node ingest: ~25s on a loaded CPU host
 def test_data_to_train_ingest_two_nodes(cluster, tmp_path):
     cluster.add_node(num_cpus=4)
     ds = rtd.range(400, override_num_blocks=8).map_batches(

@@ -294,6 +294,7 @@ class TestPipelineParallel:
             got = float(jax.jit(pp_loss)(stacked, toks, targets))
         assert abs(got - ref) < 1e-4, (got, ref)
 
+    @pytest.mark.slow  # pipeline-parallel train: ~15s on a loaded CPU host
     def test_pp_grads_flow_and_train(self):
         """jax.grad through ppermute: a few pipelined steps reduce the loss
         and every stage's layer gradients are nonzero."""
@@ -374,6 +375,7 @@ class TestGradAccum:
 
 
 class TestGenerate:
+    @pytest.mark.slow  # full decode sweep: ~15s on a loaded CPU host
     def test_kv_cache_decode_matches_full_forward(self):
         """Greedy generation through the KV cache must produce exactly the
         tokens a full re-forward per step would (cache correctness incl.

@@ -94,6 +94,7 @@ class TestFlashAttention:
 
 
 class TestRingAttention:
+    @pytest.mark.slow  # full-attention sweep: ~10s on a loaded CPU host
     def test_matches_full_attention(self):
         mesh = make_mesh(MeshConfig(fsdp=1, sp=8, dp=1, tp=1))
         B, H, S, D = 2, 4, 256, 32
@@ -112,6 +113,7 @@ class TestRingAttention:
         out = ring(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow  # ring-attention grad: ~30s on a loaded CPU host
     def test_grad_flows(self):
         mesh = make_mesh(MeshConfig(fsdp=1, sp=8))
         q, k, v = _qkv(B=1, H=2, S=128, D=32)
@@ -159,6 +161,7 @@ class TestRingAttention:
         out = ring(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
 
+    @pytest.mark.slow  # fused-kernel grad check: ~20s on a loaded CPU host
     def test_fused_kernel_grad_matches(self):
         import numpy as _np
         from jax.sharding import Mesh as _Mesh
@@ -188,6 +191,7 @@ class TestRingAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=5e-2, rtol=5e-2)
 
+    @pytest.mark.slow  # fused-kernel GQA grad: ~20s on a loaded CPU host
     def test_fused_kernel_gqa_grad(self):
         """GQA (fewer KV heads) through the fused ring kernels."""
         import numpy as _np

@@ -66,6 +66,7 @@ def test_learner_update_with_mesh():
     assert np.isfinite(metrics["total_loss"])
 
 
+@pytest.mark.slow  # learning-to-convergence: ~1 min on a loaded CPU host
 def test_ppo_cartpole_reaches_450(rt):
     algo = (
         PPOConfig()
@@ -114,6 +115,7 @@ def test_replay_buffer_ring_semantics():
     assert 0 not in buf.actions and 1 not in buf.actions
 
 
+@pytest.mark.slow  # learning test: ~15s on a loaded CPU host
 def test_dqn_cartpole_learns(rt):
     """DQN reaches a clearly-learning return on CartPole (the reference's
     tuned_examples/dqn/cartpole_dqn.py asserts reward thresholds; a lower
@@ -183,6 +185,7 @@ def test_vtrace_reduces_to_gae_lambda1_on_policy():
     np.testing.assert_allclose(vs, expect, rtol=1e-5)
 
 
+@pytest.mark.slow  # learning-to-convergence: ~2 min on a loaded CPU host
 def test_impala_cartpole_reaches_450(rt):
     """IMPALA: async pipelined sampling (weights arrive on a cadence, so
     fragments are genuinely off-policy) + V-trace learner reaches the same
@@ -250,6 +253,7 @@ def test_catch_env_and_cnn_forward():
     assert logits.shape == (7, 3) and value.shape == (7,)
 
 
+@pytest.mark.slow  # learning test: ~15s on a loaded CPU host
 def test_ppo_conv_policy_learns_catch(rt):
     """The learner stack is not MLP-bound: a conv policy (auto-picked from
     the image obs shape) learns Catch well above the random baseline
@@ -310,6 +314,7 @@ def test_multi_agent_cartpole_semantics():
     assert term["__all__"]
 
 
+@pytest.mark.slow  # learning-to-convergence: ~1 min on a loaded CPU host
 def test_multi_agent_ppo_two_policies_route_and_learn(rt):
     """Two separate policies: batches route by policy_mapping_fn, weights
     diverge, and the shared task still learns (mean return rises well above
@@ -383,6 +388,7 @@ def test_pendulum_env_and_sac_units():
     assert m["alpha"] > 0
 
 
+@pytest.mark.slow  # learning-to-convergence: ~2 min on a loaded CPU host
 def test_sac_pendulum_improves(rt):
     """SAC on Pendulum: returns rise far above the random-policy baseline
     (~-1200) within a bounded budget (reference: tuned_examples/sac/

@@ -117,6 +117,7 @@ def _asha_fn(config):
     return {"score": config["q"], "training_iteration": 30}
 
 
+@pytest.mark.slow  # multi-trial search: ~10s on a loaded CPU host
 def test_tuner_asha_early_stops(rt, tmp_path):
     from ray_tpu.train import RunConfig
 
@@ -502,6 +503,7 @@ def test_tpe_searcher_concentrates_on_optimum(rt):
         0.5 * np.mean([l for _, l in history[:10]])
 
 
+@pytest.mark.slow  # multi-trial search: ~12s on a loaded CPU host
 def test_tpe_searcher_with_tuner(rt):
     """TPESearcher drives the real Tuner loop through the Searcher
     protocol (suggest -> trial -> on_trial_complete)."""

@@ -102,7 +102,7 @@ def test_workflow_parallel_branches(rt, tmp_path):
     out = workflow.run(node, workflow_id="par", storage=str(tmp_path))
     wall = _t.time() - t0
     assert out == 6
-    assert wall < 2.5, f"branches serialized: {wall:.1f}s for 4x0.8s steps"
+    assert wall < 3.0, f"branches serialized: {wall:.1f}s for 4x0.8s steps"
 
 
 def test_dynamic_workflow_fans_out_children(rt, tmp_path):
