@@ -17,6 +17,7 @@ from .core.api import (
     cluster_resources,
     get,
     get_actor,
+    get_log,
     init,
     is_initialized,
     kill,
@@ -28,6 +29,8 @@ from .core.api import (
     remote,
     remove_placement_group,
     shutdown,
+    stack_dump,
+    task_events,
     timeline,
     wait,
 )
@@ -61,6 +64,7 @@ __all__ = [
     "remove_placement_group", "PlacementGroup",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
     "cluster_resources", "available_resources", "nodes", "timeline",
+    "task_events", "get_log", "stack_dump",
     "ObjectRef", "ObjectRefGenerator", "ActorClass", "ActorHandle",
     "exceptions", "get_runtime_context", "__version__",
 ]

@@ -121,7 +121,9 @@ class Cluster:
             # Grace window between SIGTERM (preemption notice) and daemon
             # exit — the window a training gang has to checkpoint.
             env["RT_DRAIN_GRACE_S"] = str(drain_grace_s)
-        log_dir = os.path.join("/tmp/ray_tpu_logs", session)
+        from ray_tpu.core.node_main import LOG_ROOT
+
+        log_dir = os.path.join(LOG_ROOT, session)
         os.makedirs(log_dir, exist_ok=True)
         logf = open(os.path.join(log_dir, "node-daemon.log"), "wb")
         proc = subprocess.Popen(

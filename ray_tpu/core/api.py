@@ -1025,3 +1025,85 @@ def nodes() -> List[dict]:
 def timeline() -> List[dict]:
     _ensure_init()
     return ctx.client.call("list_state", {"kind": "timeline"})["items"]
+
+
+def task_events(task_id: Optional[str] = None,
+                errors: bool = False) -> List[dict]:
+    """Retained per-task lifecycle histories (SUBMITTED/SCHEDULED/RUNNING/
+    FINISHED/FAILED transitions with timestamps, placement, and the full
+    traceback on failure).  Failed-task records survive worker and node
+    death — they live at the head."""
+    _ensure_init()
+    body: Dict[str, Any] = {"kind": "task_events"}
+    if task_id:
+        body["task_id"] = task_id
+    if errors:
+        body["errors"] = True
+    return ctx.client.call("list_state", body)["items"]
+
+
+def iter_log_chunks(call, proc_id: str, offset: int = 0,
+                    max_bytes: int = -1, follow: bool = False,
+                    poll_s: float = 0.5, chunk_bytes: int = 1 << 20):
+    """Yield raw byte chunks of a process's log via repeated ``get_log``
+    head RPCs — the one paging loop shared by :func:`get_log` and the CLI.
+    ``call`` is any head-RPC callable (``Client.call``).  ``max_bytes >= 0``
+    caps the TOTAL bytes yielded, in follow mode too; ``follow=True`` keeps
+    polling a live process and stops once it is dead and drained."""
+    off, remaining = offset, max_bytes
+    while True:
+        want = chunk_bytes if remaining < 0 else min(chunk_bytes, remaining)
+        if want == 0:
+            return
+        reply = call(
+            "get_log", {"proc_id": proc_id, "offset": off, "max_bytes": want}
+        )
+        if not reply.get("found"):
+            raise RuntimeError(reply.get("error", f"no log for {proc_id!r}"))
+        data = reply.get("data") or b""
+        if data:
+            off = reply.get("next_offset", off + len(data))
+            if remaining > 0:
+                remaining -= len(data)
+            yield data
+        if follow:
+            if not data:
+                if not reply.get("alive", False):
+                    return  # dead and drained: nothing more can arrive
+                time.sleep(poll_s)
+        elif reply.get("eof", True) or not data:
+            return
+
+
+def get_log(proc_id: str, offset: int = 0, max_bytes: int = -1,
+            follow: bool = False):
+    """Fetch a process's log through the head's cluster log index — works
+    from any machine, for live AND exited processes (crash post-mortems).
+
+    ``proc_id`` is a worker/node id (hex, unique prefix ok), an actor id
+    (resolves to its hosting worker), or a pid.  A negative ``offset``
+    addresses from the end of the file (tail).  ``max_bytes=-1`` reads to
+    EOF; ``max_bytes >= 0`` caps the total bytes read (follow included).
+    With ``follow=True`` returns a generator that yields text chunks as
+    the process writes (stops once the process is dead and the file is
+    drained)."""
+    _ensure_init()
+    chunks = iter_log_chunks(ctx.client.call, proc_id, offset, max_bytes,
+                             follow)
+    if follow:
+        return (c.decode("utf-8", "replace") for c in chunks)
+    return b"".join(chunks).decode("utf-8", "replace")
+
+
+def stack_dump(worker_id: str, timeout: float = 10.0) -> str:
+    """All-thread Python stacks from a live worker (id/prefix, or an actor
+    id), collected without interrupting the running task — the first tool
+    to reach for when a gang hangs in a collective."""
+    _ensure_init()
+    reply = ctx.client.call(
+        "stack_dump", {"worker_id": worker_id, "timeout": timeout},
+        timeout=timeout + 30,
+    )
+    if not reply.get("found") or not reply.get("ok"):
+        raise RuntimeError(reply.get("error", "stack dump failed"))
+    return reply["dump"]

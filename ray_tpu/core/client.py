@@ -29,7 +29,7 @@ from .rpc import ConnectionLost, RpcClient
 IDEMPOTENT_METHODS = frozenset({
     "list_state", "kv_get", "kv_keys", "cluster_resources",
     "available_resources", "store_stats", "object_sizes", "ping",
-    "get_actor_by_name", "list_named_actors", "health_ack",
+    "get_actor_by_name", "list_named_actors", "health_ack", "get_log",
 })
 #: attempts / base delay for the jittered exponential backoff below.
 IDEMPOTENT_RETRY_ATTEMPTS = 3
@@ -45,6 +45,7 @@ class Client:
         node_id: Optional[bytes] = None,
         pid: int = 0,
         session: Optional[str] = None,
+        log_path: Optional[str] = None,
     ):
         from . import schema as wire_schema
 
@@ -55,6 +56,10 @@ class Client:
             "kind": kind, "pid": pid,
             "protocol": wire_schema.PROTOCOL_VERSION,
         }
+        if log_path:
+            # Registered in the head's cluster log index (retained past
+            # process death) so `get_log` can serve this process's output.
+            body["log_path"] = log_path
         if kind == "driver" and os.environ.get("RT_FORCE_PROXY_DRIVER") == "1":
             # Opt into the off-host proxy path explicitly (tests; also
             # useful when the driver host has no usable /dev/shm).

@@ -117,6 +117,21 @@ class Config:
     # must not grow head memory without bound; new series beyond the cap
     # are dropped (the ones already retained keep recording).
     metrics_history_max_series: int = 1024
+    # -- debugging plane ------------------------------------------------------
+    # Cluster-wide log index: every worker/daemon registers its log file at
+    # startup; entries of exited processes are RETAINED for crash
+    # post-mortems (`get_log` on a dead worker) until this bound evicts
+    # them, dead-oldest first (reference: the GCS keeps worker table
+    # entries past death for `ray logs`).
+    log_index_max_entries: int = 2000
+    # Per-task lifecycle histories (SUBMITTED/SCHEDULED/RUNNING/FINISHED/
+    # FAILED transitions + failure traceback) retained for
+    # list_state(kind="task_events") (reference: gcs_task_manager.h task
+    # event store).  0 disables recording.
+    task_history_max_tasks: int = 10_000
+    # Transition events kept per task record (retry loops must not grow a
+    # record without bound; the oldest post-SUBMITTED events drop first).
+    task_history_max_events: int = 64
 
     def __post_init__(self):
         if self.object_store_memory == 0:

@@ -246,6 +246,21 @@ class Head:
         self.node_last_ack: Dict[NodeID, float] = {}
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
         self._events_since_persist = 0
+        # -- debugging plane --------------------------------------------------
+        # Cluster-wide log index: proc_id (worker/node hex) -> registered log
+        # file + liveness.  Entries of EXITED processes are retained (bounded,
+        # dead-oldest evicted first) so `get_log` works for crash post-mortems
+        # (reference: the GCS worker table keeps dead workers for `ray logs`).
+        self.log_index: "OrderedDict[str, dict]" = OrderedDict()
+        # Per-task lifecycle histories: task hex -> record with a bounded
+        # transition list + failure traceback, queryable via
+        # list_state(kind="task_events") (reference: gcs_task_manager.h —
+        # task events survive the worker because the HEAD holds them).
+        self.task_history: "OrderedDict[str, dict]" = OrderedDict()
+        # In-flight stack-dump round-trips: token -> future resolved by the
+        # worker's stack_dump_reply.
+        self._stack_waiters: Dict[int, asyncio.Future] = {}
+        self._stack_token = 0
         # Named actors that could NOT be restored after a head restart
         # (constructor args lived in the dead session's object store):
         # name -> human-readable reason, surfaced by get_actor(name)
@@ -328,6 +343,7 @@ class Head:
             "actor_restarting", "restore_object", "store_stats",
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
             "node_health_ack", "node_stats", "node_drain", "span",
+            "get_log", "stack_dump", "stack_dump_reply",
         ]:
             self.server.register(
                 name, _validated(name, getattr(self, f"h_{name}"))
@@ -352,6 +368,119 @@ class Head:
             if self._events_since_persist >= 100:
                 self._events_since_persist = 0
                 self._mark_dirty()
+
+    # -- debugging plane: log index + task lifecycle history ------------------
+
+    def _log_register(self, proc_id: str, kind: str, node_id: NodeID,
+                      pid: int, log_path: str):
+        """Add (or refresh) a process's entry in the cluster log index."""
+        cap = self.config.log_index_max_entries
+        if cap <= 0:
+            return
+        self.log_index.pop(proc_id, None)
+        self.log_index[proc_id] = {
+            "proc_id": proc_id,
+            "kind": kind,
+            "node_id": node_id.hex(),
+            "pid": pid or 0,
+            "log_path": log_path or "",
+            "alive": True,
+            "actor_id": None,
+            "start_time": time.time(),
+            "end_time": None,
+        }
+        while len(self.log_index) > cap:
+            victim = next(
+                (p for p, e in self.log_index.items() if not e["alive"]), None
+            )
+            if victim is None:
+                self.log_index.popitem(last=False)
+            else:
+                self.log_index.pop(victim)
+
+    def _log_mark_dead(self, proc_id: str):
+        entry = self.log_index.get(proc_id)
+        if entry is not None and entry["alive"]:
+            entry["alive"] = False
+            entry["end_time"] = time.time()
+
+    def _resolve_log_entry(self, query: str):
+        """Match a log-index entry by worker/node id (exact or unique
+        prefix), the actor an entry's worker hosts/hosted, or pid.
+        Returns ``(entry, error)`` — an ambiguous prefix gets an explicit
+        error, never a misleading not-found (nor an arbitrary match)."""
+        if not query:
+            return None, "empty process id"
+        entry = self.log_index.get(query)
+        if entry is not None:
+            return entry, None
+        matches = [
+            e for pid, e in self.log_index.items()
+            if pid.startswith(query)
+            or (e["actor_id"] or "").startswith(query)
+        ]
+        if len(matches) == 1:
+            return matches[0], None
+        if len(matches) > 1:
+            return None, (f"{query!r} is ambiguous: matches "
+                          f"{len(matches)} processes — use a longer prefix "
+                          "(see list_state(kind='logs'))")
+        if query.isdigit():
+            by_pid = [e for e in self.log_index.values()
+                      if e["pid"] == int(query)]
+            if len(by_pid) == 1:
+                return by_pid[0], None
+            if len(by_pid) > 1:
+                return None, (f"pid {query} matches {len(by_pid)} "
+                              "processes (recycled pid) — use the "
+                              "worker/node id instead")
+        return None, (f"no log registered for {query!r} "
+                      "(see list_state(kind='logs') for known ids)")
+
+    def _task_transition(self, task: "TaskRecord", state: str,
+                         node: Optional[NodeID] = None,
+                         error: Optional[str] = None,
+                         traceback_text: Optional[str] = None):
+        """Append one lifecycle transition to the task's retained history
+        (the task-event store: SUBMITTED/SCHEDULED/RUNNING/RETRYING/
+        FINISHED/FAILED with timestamps, placement, and the full traceback
+        on failure — survives worker and node death by living here)."""
+        cap = self.config.task_history_max_tasks
+        if cap <= 0:
+            return
+        hexid = task.task_id.hex()
+        rec = self.task_history.get(hexid)
+        if rec is None:
+            rec = self.task_history[hexid] = {
+                "task_id": hexid,
+                "name": task.spec.get("name", ""),
+                "actor_id": (ActorID(task.spec["actor_id"]).hex()
+                             if task.spec.get("actor_id") else None),
+                "state": state,
+                "node_id": None,
+                "worker_id": None,
+                "error": None,
+                "traceback": None,
+                "events": [],
+            }
+            while len(self.task_history) > cap:
+                self.task_history.popitem(last=False)
+        ev: Dict[str, Any] = {"state": state, "ts": time.time()}
+        nid = node or task.node_id
+        if nid is not None:
+            rec["node_id"] = ev["node"] = nid.hex()
+        if task.worker_id is not None:
+            rec["worker_id"] = ev["worker"] = task.worker_id.hex()
+        if error:
+            rec["error"] = ev["error"] = error
+        if traceback_text:
+            rec["traceback"] = traceback_text
+        rec["state"] = state
+        events = rec["events"]
+        events.append(ev)
+        if len(events) > self.config.task_history_max_events:
+            # Keep the SUBMITTED head; a retry loop sheds its oldest middle.
+            del events[1]
 
     def _obj(self, oid: ObjectID) -> ObjectRecord:
         rec = self.objects.get(oid)
@@ -751,7 +880,9 @@ class Head:
         if daemon is not None:
             asyncio.ensure_future(daemon.push("spawn_worker", {}))
             return
-        log_dir = os.path.join("/tmp/ray_tpu_logs", self.session)
+        from .node_main import LOG_ROOT
+
+        log_dir = os.path.join(LOG_ROOT, self.session)
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
 
@@ -793,6 +924,8 @@ class Head:
             self.conn_to_worker[conn.conn_id] = worker_id
             conn.meta["kind"] = "worker"
             conn.meta["reader_node"] = node_id
+            self._log_register(worker_id.hex(), "worker", node_id,
+                               body.get("pid", 0), body.get("log_path", ""))
             if self._spawn_pending.get(node_id, 0) > 0:
                 self._spawn_pending[node_id] -= 1
                 times = self._spawn_times.get(node_id)
@@ -817,6 +950,8 @@ class Head:
             self.node_last_ack[node_id] = time.monotonic()
             conn.meta["kind"] = "node"
             conn.meta["node_id"] = node_id
+            self._log_register(node_id.hex(), "node", node_id,
+                               body.get("pid", 0), body.get("log_path", ""))
             self._kick()
             return {"session": self.session, "node_id": node_id.binary()}
         # Drivers on the head host attach its shm session for zero-copy
@@ -889,6 +1024,7 @@ class Head:
             await self._handle_worker_death(worker_id)
         node_id = conn.meta.get("node_id")
         if node_id is not None and conn.meta.get("kind") == "node":
+            self._log_mark_dead(node_id.hex())
             self.node_daemons.pop(node_id, None)
             self.node_object_addrs.pop(node_id, None)
             self.node_bulk_addrs.pop(node_id, None)
@@ -1761,6 +1897,7 @@ class Head:
     async def h_submit_task(self, conn, body):
         task = TaskRecord(body)
         self._register_task(task)
+        self._task_transition(task, "SUBMITTED")
         self._event("task_submitted", task=task.task_id.hex(), name=body.get("name", ""))
         if not task.pending_deps:
             self._enqueue_task(task)
@@ -1835,6 +1972,7 @@ class Head:
                     if all(k in failed_shapes for k in self.queue_shapes):
                         break  # nothing left in the queue can place
                     continue
+                self._task_transition(task, "SCHEDULED", node=node_id)
                 worker = self._find_idle_worker(
                     node_id, fresh=self._needs_chip_grant(task)
                 )
@@ -2018,6 +2156,7 @@ class Head:
         is_actor_creation = task.spec.get("is_actor_creation", False)
         worker.state = ACTOR if is_actor_creation else LEASED
         worker.inflight.add(task.task_id)
+        self._task_transition(task, "RUNNING")
         self._event("task_dispatched", task=task.task_id.hex(),
                     worker=worker.worker_id.hex())
         if is_actor_creation:
@@ -2026,6 +2165,11 @@ class Head:
             actor.worker_id = worker.worker_id
             actor.node_id = worker.node_id
             worker.actor_id = actor_id
+            # Log-index linkage: `ray_tpu logs <actor_id>` resolves to the
+            # hosting worker's file (retained after the actor dies).
+            log_entry = self.log_index.get(worker.worker_id.hex())
+            if log_entry is not None:
+                log_entry["actor_id"] = actor_id.hex()
         await worker.conn.push("execute_task", task.spec)
         return True
 
@@ -2044,6 +2188,8 @@ class Head:
             task.retries_left -= 1
             task.state = PENDING
             self._release_task_resources(task, worker)
+            self._task_transition(task, "RETRYING",
+                                  error=body.get("error_repr", ""))
             task.worker_id = None
             task.node_id = None
             if task.is_actor_task:
@@ -2064,6 +2210,13 @@ class Head:
         task.end_time = time.time()
         if failed:
             task.error = body.get("error_repr", "")
+            self._task_transition(
+                task, FAILED, error=task.error,
+                traceback_text=body.get("error_tb")
+                or body.get("error_repr", ""),
+            )
+        else:
+            self._task_transition(task, FINISHED)
         for ret in body.get("returns", []):
             oid = ObjectID(ret["object_id"])
             if task.spec.get("_reconstruct") and oid not in self.objects:
@@ -2337,6 +2490,7 @@ class Head:
         if task.state == PENDING:
             task.state = FAILED
             task.error = "cancelled"
+            self._task_transition(task, FAILED, error="cancelled")
             err = serialization.pack(TaskCancelledError(task_id.hex()))
             for raw in task.spec.get("return_ids", []):
                 rec = self._obj(ObjectID(raw))
@@ -2390,6 +2544,7 @@ class Head:
             return {}
         task = TaskRecord(body)
         self._register_task(task)
+        self._task_transition(task, "SUBMITTED")
         # Strict per-actor FIFO: anything already queued keeps its place
         # (reference: sequential_actor_submit_queue.h).
         if actor.state != "ALIVE" or task.pending_deps or actor.pending_tasks:
@@ -2434,6 +2589,7 @@ class Head:
         task.start_time = time.time()
         self.builtin_metrics.tasks_dispatched.inc()
         worker.inflight.add(task.task_id)
+        self._task_transition(task, "RUNNING")
         await worker.conn.push("execute_task", task.spec)
         return True
 
@@ -2565,6 +2721,7 @@ class Head:
         if worker is None:
             return
         worker.state = DEAD
+        self._log_mark_dead(worker_id.hex())
         oom_killed = self._oom_kills.pop(worker_id, None) is not None
         self.node_worker_counts[worker.node_id] = max(
             0, self.node_worker_counts.get(worker.node_id, 1) - 1
@@ -2611,6 +2768,8 @@ class Head:
                 # max_task_retries after actor restart).
                 task.retries_left -= 1
                 task.state = PENDING
+                self._task_transition(task, "RETRYING",
+                                      error="worker process died")
                 task.worker_id = None
                 task.node_id = None
                 self._event("task_retry", task=task.task_id.hex())
@@ -2618,6 +2777,8 @@ class Head:
             elif task.retries_left != 0 and not task.spec.get("actor_id"):
                 task.retries_left -= 1
                 task.state = PENDING
+                self._task_transition(task, "RETRYING",
+                                      error="worker process died")
                 task.worker_id = None
                 self._event("task_retry", task=task.task_id.hex())
                 self._enqueue_task(task)
@@ -2628,12 +2789,16 @@ class Head:
                     "crossed memory_usage_threshold)"
                     if oom_killed else ""
                 )
-                err = serialization.pack(
-                    WorkerCrashedError(
-                        f"worker {worker_id.hex()[:8]} died while running "
-                        f"task{cause}"
-                    )
+                crash_msg = (
+                    f"worker {worker_id.hex()[:8]} died while running "
+                    f"task{cause}"
                 )
+                task.error = crash_msg
+                # The FAILED record outlives the dead worker (and its node):
+                # it lives in the head's task history, not the worker.
+                self._task_transition(task, FAILED, error=crash_msg,
+                                      traceback_text=crash_msg)
+                err = serialization.pack(WorkerCrashedError(crash_msg))
                 for raw in task.spec.get("return_ids", []):
                     rec = self._obj(ObjectID(raw))
                     rec.error = err
@@ -2813,6 +2978,139 @@ class Head:
                 total[k] = total.get(k, 0.0) + v
         return {"resources": total}
 
+    # -- debugging plane: log retrieval + stack dumps --------------------------
+
+    async def _node_call(self, addr: str, method: str, body: dict,
+                         timeout: float = 10.0):
+        """One-shot async RPC to a node daemon's server (the head is a
+        *server* to daemons — their Connection only supports pushes — so
+        routed reads like get_log dial the node's object-plane endpoint)."""
+        from .rpc import ERR, REQ, RESP, RpcError, RpcServer, _encode, _read_msg
+
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port),
+                                    limit=RpcServer.STREAM_LIMIT),
+            timeout=timeout,
+        )
+        try:
+            writer.write(_encode([REQ, 1, method, body]))
+            await writer.drain()
+            while True:
+                mtype, _seq, _m, rbody = await asyncio.wait_for(
+                    _read_msg(reader), timeout=timeout
+                )
+                if mtype == RESP:
+                    return rbody
+                if mtype == ERR:
+                    raise RpcError(rbody)
+        finally:
+            writer.close()
+
+    async def h_get_log(self, conn, body):
+        """Ranged log read routed head -> owning node -> file.  Works for
+        live AND exited processes (the index retains dead entries): the
+        crash post-mortem path of `ray_tpu logs` and the dashboard."""
+        query = str(body["proc_id"])
+        entry, resolve_error = self._resolve_log_entry(query)
+        if entry is None:
+            return {"found": False, "error": resolve_error}
+        if not entry["log_path"]:
+            return {"found": False, "alive": entry["alive"],
+                    "error": f"process {query!r} registered no log file"}
+        offset = body.get("offset", 0)
+        max_bytes = body.get("max_bytes", 65536)
+        from .node_main import read_log_range
+
+        node_hex = entry["node_id"]
+        local_hex = self.local_node_id.hex() if self.local_node_id else ""
+        reply: Optional[dict] = None
+        if node_hex != local_hex:
+            # Route to the owning node's daemon; a dead/unreachable node
+            # falls back to a direct read (single-host clusters share the
+            # filesystem, so post-mortems still work after node death).
+            nid = next((n for n in self.node_object_addrs
+                        if n.hex() == node_hex), None)
+            addr = self.node_object_addrs.get(nid) if nid else None
+            if addr is not None:
+                try:
+                    reply = await self._node_call(
+                        addr, "read_log",
+                        {"path": entry["log_path"], "offset": offset,
+                         "max_bytes": max_bytes},
+                    )
+                except Exception:
+                    reply = None
+        if reply is None:
+            reply = await asyncio.get_running_loop().run_in_executor(
+                None, read_log_range, entry["log_path"], offset, max_bytes
+            )
+        reply["alive"] = entry["alive"]
+        reply["proc"] = {k: entry[k] for k in
+                         ("proc_id", "kind", "node_id", "pid", "actor_id")}
+        return reply
+
+    async def h_stack_dump(self, conn, body):
+        """All-thread Python stacks from a live worker, on demand and
+        without interrupting the running task (the worker collects them on
+        its rpc thread) — the hung-gang diagnosis tool (`ray_tpu stack`)."""
+        query = str(body["worker_id"])
+        # Prefix resolution requires UNIQUENESS: during an incident, dumping
+        # an arbitrary first match would silently debug the wrong process.
+        matches = [w for wid, w in self.workers.items()
+                   if wid.hex() == query or wid.hex().startswith(query)]
+        if not matches:
+            # Accept an actor id: resolve to its hosting worker.
+            matches = [
+                self.workers[actor.worker_id]
+                for aid, actor in self.actors.items()
+                if actor.worker_id in self.workers
+                and (aid.hex() == query or aid.hex().startswith(query))
+            ]
+        if len(matches) > 1:
+            return {"found": False,
+                    "error": f"{query!r} is ambiguous: matches "
+                             f"{len(matches)} workers — use a longer "
+                             "prefix (see `list workers`)"}
+        worker = matches[0] if matches else None
+        if worker is None or not worker.conn.alive:
+            return {"found": False,
+                    "error": f"no live worker matches {query!r}"}
+        self._stack_token += 1
+        token = self._stack_token
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._stack_waiters[token] = fut
+        try:
+            await worker.conn.push("stack_dump", {"token": token})
+            reply = await asyncio.wait_for(
+                fut, timeout=float(body.get("timeout", 10.0))
+            )
+        except asyncio.TimeoutError:
+            return {"found": True, "ok": False,
+                    "worker_id": worker.worker_id.hex(),
+                    "error": "worker did not reply in time (rpc thread "
+                             "wedged? try SIGUSR1 for a faulthandler dump "
+                             "to its log file)"}
+        except Exception as e:
+            return {"found": True, "ok": False,
+                    "worker_id": worker.worker_id.hex(), "error": str(e)}
+        finally:
+            self._stack_waiters.pop(token, None)
+        return {
+            "found": True, "ok": True,
+            "worker_id": worker.worker_id.hex(),
+            "node_id": worker.node_id.hex(),
+            "pid": reply.get("pid", worker.pid),
+            "threads": reply.get("threads", 0),
+            "dump": reply.get("dump", ""),
+        }
+
+    async def h_stack_dump_reply(self, conn, body):
+        fut = self._stack_waiters.get(body.get("token"))
+        if fut is not None and not fut.done():
+            fut.set_result(body)
+        return {}
+
     async def h_list_state(self, conn, body):
         kind = body["kind"]
         if kind == "nodes":
@@ -2905,6 +3203,18 @@ class Head:
             return {"items": items}
         if kind == "timeline":
             return {"items": list(self.task_events)}
+        if kind == "logs":
+            # Cluster-wide log index, exited processes included (their
+            # entries are what crash post-mortems route through).
+            return {"items": [dict(e) for e in self.log_index.values()]}
+        if kind == "task_events":
+            items = list(self.task_history.values())
+            tid = body.get("task_id")
+            if tid:
+                items = [r for r in items if r["task_id"].startswith(tid)]
+            if body.get("errors"):
+                items = [r for r in items if r["state"] == FAILED]
+            return {"items": items}
         if kind == "metrics":
             return {"items": self.metrics_rows()}
         if kind == "metrics_history":

@@ -143,6 +143,75 @@ def _recv_exact(conn: socket.socket, n: int):
     return bytes(buf)
 
 
+# Log files live under this root; ranged log reads refuse anything else so
+# the read-log RPC can never be aimed at an arbitrary file.
+LOG_ROOT = "/tmp/ray_tpu_logs"
+LOG_READ_MAX_BYTES = 4 * 1024 * 1024
+
+
+def own_log_path() -> str:
+    """This process's own log file, for registration with the head's log
+    index: the spawner exports RT_LOG_PATH; processes started with plain
+    stdout redirection (node daemons under cluster_utils) discover it from
+    /proc, restricted to the cluster log root."""
+    path = os.environ.get("RT_LOG_PATH", "")
+    if path:
+        return path
+    try:
+        target = os.readlink("/proc/self/fd/1")
+        if target.startswith(LOG_ROOT + os.sep) and os.path.isfile(target):
+            return target
+    except OSError:
+        pass
+    return ""
+
+
+def read_log_range(path: str, offset=0, max_bytes=65536) -> dict:
+    """Ranged read of a registered log file.  Negative offsets address from
+    the end (tail); replies carry `next_offset` so callers can stream
+    (`follow`) without re-reading.  Shared by the node daemon's `read_log`
+    handler and the head (which reads its own node's files directly)."""
+    real = os.path.realpath(path or "")
+    # realpath BOTH sides: on hosts where /tmp is itself a symlink (macOS
+    # /tmp -> /private/tmp), the literal root would never prefix-match.
+    root = os.path.realpath(LOG_ROOT)
+    if not real.startswith(root + os.sep):
+        return {"found": False,
+                "error": f"log path {path!r} is outside {LOG_ROOT}"}
+    try:
+        size = os.path.getsize(real)
+        off = int(offset)
+        if off < 0:
+            off = max(0, size + off)
+        n = max(0, min(int(max_bytes), LOG_READ_MAX_BYTES))
+        with open(real, "rb") as f:
+            f.seek(off)
+            data = f.read(n)
+    except OSError as e:
+        return {"found": False, "error": f"cannot read {path}: {e}"}
+    return {
+        "found": True,
+        "data": data,
+        "offset": off,
+        "next_offset": off + len(data),
+        "size": size,
+        "eof": off + len(data) >= size,
+    }
+
+
+def make_log_read_handler():
+    """`read_log` for a node's RPC server: the head routes `get_log` calls
+    for this node's processes here (head -> owning node -> file)."""
+
+    async def h_read_log(conn, body):
+        return read_log_range(
+            body.get("path", ""), body.get("offset", 0),
+            body.get("max_bytes", 65536),
+        )
+
+    return h_read_log
+
+
 def make_pull_handler(store: ObjectStore):
     """Chunked object reads from a node store.  Shared by the node daemon and
     the head (which serves its own local node's objects)."""
@@ -185,6 +254,7 @@ class NodeDaemon:
         )
         self.server = RpcServer(host=self.host)
         self.server.register("pull_object", make_pull_handler(self.store))
+        self.server.register("read_log", make_log_read_handler())
         self.server.register("ping", lambda conn, body: {"ok": True})
         self.server_thread = ServerThread(self.server)
         self.bulk_server = BulkServer(self.store, self.session, self.host)
@@ -236,6 +306,8 @@ class NodeDaemon:
             "store_session": self.session,
             "object_addr": f"{self.host}:{port}",
             "bulk_addr": f"{self.host}:{self.bulk_server.port}",
+            "pid": os.getpid(),
+            "log_path": own_log_path(),
         }
         if os.environ.get("RT_NODE_ID"):  # pre-assigned (cluster_utils)
             body["node_id"] = bytes.fromhex(os.environ["RT_NODE_ID"])
@@ -287,7 +359,7 @@ class NodeDaemon:
         from .zygote import spawn_with_fallback
 
         env = self._worker_env()
-        log_dir = os.path.join("/tmp/ray_tpu_logs", self.session)
+        log_dir = os.path.join(LOG_ROOT, self.session)
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
         self.zygote, pid, proc = spawn_with_fallback(

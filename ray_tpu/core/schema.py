@@ -68,6 +68,9 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "node_drain": (("node_id", _BYTES),),
     "span": (("trace_id", str), ("span_id", str), ("name", str)),
     "restore_object": (("object_id", _BYTES),),
+    "get_log": (("proc_id", str),),
+    "stack_dump": (("worker_id", str),),
+    "stack_dump_reply": (("token", _NUM), ("dump", str)),
 }
 
 

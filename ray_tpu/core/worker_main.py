@@ -58,6 +58,8 @@ class _LogTee:
         # shared bg-error channel or block a task — past the window they
         # drop (the log file keeps the full copy).
         self._inflight: list = []
+        self.dropped = 0
+        self._drop_counter = None  # resolved lazily, once, on first drop
 
     def write(self, s):
         n = self._stream.write(s)
@@ -75,7 +77,25 @@ class _LogTee:
             try:
                 self._inflight = [f for f in self._inflight if not f.done()]
                 if len(self._inflight) >= 200:
-                    continue  # head is behind: drop rather than block
+                    # Head is behind: drop rather than block — but visibly
+                    # (the drop count ships with the process metrics, so a
+                    # chatty worker outrunning the head is diagnosable).
+                    self.dropped += 1
+                    try:
+                        if self._drop_counter is None:
+                            from ray_tpu.util.metrics import get_counter
+
+                            self._drop_counter = get_counter(
+                                "ray_tpu_logs_dropped_total",
+                                "worker log lines dropped past the "
+                                "in-flight publish window (the log file "
+                                "keeps them)",
+                                tag_keys=("stream",),
+                            )
+                        self._drop_counter.inc(tags={"stream": self._kind})
+                    except Exception:
+                        pass
+                    continue
                 self._inflight.append(self._client.rpc.call_async(
                     "publish", {
                         "topic": "worker_logs",
@@ -94,12 +114,36 @@ class _LogTee:
     def flush(self):
         self._stream.flush()
 
+    def flush_residual(self, timeout: float = 1.0):
+        """Ship a trailing partial line (no newline) at worker shutdown —
+        without this, a final ``print(..., end="")`` before exit never
+        reaches the driver."""
+        with self._buf_lock:
+            line, self._buf = self._buf, ""
+        if not line.strip():
+            return
+        self._local.publishing = True
+        try:
+            self._client.rpc.call_async("publish", {
+                "topic": "worker_logs",
+                "data": {"pid": os.getpid(), "stream": self._kind,
+                         "actor": ctx.current_actor_id.hex()[:8]
+                         if ctx.current_actor_id else None,
+                         "line": line},
+            }).result(timeout=timeout)
+        except Exception:
+            pass
+        finally:
+            self._local.publishing = False
+
     def __getattr__(self, name):
         return getattr(self._stream, name)
 
 
 class Worker:
     def __init__(self):
+        from .node_main import own_log_path
+
         self.head_addr = os.environ["RT_HEAD_ADDR"]
         self.node_id = bytes.fromhex(os.environ["RT_NODE_ID"])
         self.worker_id = os.urandom(16)
@@ -112,6 +156,9 @@ class Worker:
             # Object writes go under this worker's node store session (set
             # by the node daemon / head spawner), not the head's.
             session=os.environ.get("RT_SESSION"),
+            # Cluster log index entry: `get_log` serves this file from any
+            # machine, even after this process dies.
+            log_path=own_log_path(),
         )
         ctx.client = self.client
         ctx.mode = "worker"
@@ -152,6 +199,12 @@ class Worker:
             "health_check",
             lambda b: self.client.rpc.call_async("health_ack", {}),
         )
+        # On-demand introspection: dump all-thread Python stacks without
+        # touching the running task (collection happens on the rpc loop
+        # thread — the tool you reach for when a gang hangs in a
+        # collective; reference: `ray stack` attaches py-spy, here the
+        # worker cooperates via sys._current_frames).
+        self.client.rpc.on_push("stack_dump", self._on_stack_dump)
         self.client.rpc.on_connection_lost = lambda: os._exit(0)
         # Stream this worker's stdout/stderr to the driver (log files keep
         # the full copy); RT_LOG_TO_DRIVER=0 disables.
@@ -429,7 +482,7 @@ class Worker:
         return {"object_id": oid.binary(), "size": size}
 
     def _report_done(self, spec, returns=None, error=None, retryable=False,
-                     error_repr="", stream_count=0):
+                     error_repr="", error_tb="", stream_count=0):
         body = {
             "task_id": spec["task_id"],
             "returns": returns or [],
@@ -439,6 +492,10 @@ class Worker:
             body["error"] = error
             body["retryable"] = retryable
             body["error_repr"] = error_repr
+            # Full traceback text: retained in the head's task-event
+            # history so post-hoc debugging doesn't need the (possibly
+            # unserializable or already-freed) exception object.
+            body["error_tb"] = error_tb
             body["returns"] = [
                 {"object_id": raw} for raw in spec.get("return_ids", [])
             ]
@@ -698,7 +755,12 @@ class Worker:
         self._report_done(spec, returns=returns)
 
     def _finish_err(self, spec, e: BaseException):
-        tb = traceback.format_exc()
+        # From the exception object, not format_exc(): some callers reach
+        # here OUTSIDE an except block (unknown-concurrency-group paths),
+        # where format_exc() yields the garbage "NoneType: None".
+        tb = "".join(
+            traceback.format_exception(type(e), e, e.__traceback__)
+        )
         if isinstance(e, exceptions.RayTpuError):
             wrapped = e
         else:
@@ -713,7 +775,8 @@ class Worker:
             e, exceptions.TaskCancelledError
         )
         self._report_done(
-            spec, error=blob, retryable=retryable, error_repr=repr(e)
+            spec, error=blob, retryable=retryable, error_repr=repr(e),
+            error_tb=tb,
         )
 
     def _execute_async(self, spec, fn, args, kwargs):
@@ -784,6 +847,40 @@ class Worker:
 
         asyncio.run_coroutine_threadsafe(run(), self.async_loop)
 
+    # ---------------------------------------------------------- introspection
+
+    def _on_stack_dump(self, body):
+        """Collect every thread's Python stack and reply to the head.  Runs
+        on the rpc loop thread: the executing task keeps running untouched
+        (sys._current_frames is a snapshot, no signal, no interruption)."""
+        try:
+            names = {t.ident: t.name for t in threading.enumerate()}
+            tasks_by_ident = {
+                ident: tid for tid, ident in self.running_threads.items()
+            }
+            parts = []
+            for ident, frame in sorted(sys._current_frames().items()):
+                tid = tasks_by_ident.get(ident)
+                note = f" [running task {tid.hex()[:16]}]" if tid else ""
+                parts.append(
+                    f"Thread {names.get(ident, '?')} (ident={ident}){note}:\n"
+                    + "".join(traceback.format_stack(frame))
+                )
+            dump = "\n".join(parts)
+            n_threads = len(parts)
+        except Exception:
+            dump = "stack collection failed:\n" + traceback.format_exc()
+            n_threads = 0
+        try:
+            self.client.rpc.call_async("stack_dump_reply", {
+                "token": body.get("token", 0),
+                "pid": os.getpid(),
+                "threads": n_threads,
+                "dump": dump,
+            })
+        except Exception:
+            pass
+
     # ------------------------------------------------------------ cancellation
 
     def _on_cancel(self, body):
@@ -835,6 +932,18 @@ class Worker:
                 # Async methods dispatch to the actor loop from here without
                 # blocking, preserving queue order for sync methods.
                 self._execute(spec)
+        # Clean shutdown: os._exit skips atexit, so drain the log tees'
+        # trailing partial lines and ship the final metrics window (incl.
+        # the logs-dropped counter) explicitly.
+        try:
+            for stream in (sys.stdout, sys.stderr):
+                if isinstance(stream, _LogTee):
+                    stream.flush_residual()
+            from ray_tpu.util.metrics import _final_flush
+
+            _final_flush()
+        except Exception:
+            pass
         os._exit(0)
 
 

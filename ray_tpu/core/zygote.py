@@ -167,6 +167,10 @@ def spawn_with_fallback(zygote: Optional[Zygote], env: Dict[str, str],
     the node daemon."""
     import subprocess as sp
 
+    # The worker registers this path with the head's cluster log index so
+    # `get_log`/`ray_tpu logs` can retrieve its output from any machine —
+    # including after the process dies (crash post-mortems).
+    env = dict(env, RT_LOG_PATH=log_path)
     try:
         if zygote is None or not zygote.alive():
             zygote = Zygote(env)

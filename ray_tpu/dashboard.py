@@ -13,6 +13,11 @@ are needed at this scale.
 Endpoints:
     /api/nodes /api/actors /api/tasks /api/workers /api/objects
     /api/placement_groups /api/timeline /api/metrics   -> {"items": [...]}
+    /api/task_events -> per-task lifecycle histories (transitions +
+                        failure tracebacks, retained past worker death)
+    /api/logs     -> the cluster log index (exited processes included)
+    /api/log?proc=<id>[&offset=N][&max_bytes=N] -> raw log content,
+                     routed head -> owning node (negative offset = tail)
     /api/metrics/history -> retained time series per (metric, tags):
                             {"items": [{name, tags, kind, points: [[ts, v]]}]}
     /api/status   -> cluster resource totals/availability + process counts
@@ -35,7 +40,7 @@ from typing import Optional
 
 _STATE_KINDS = (
     "nodes", "actors", "tasks", "workers", "objects",
-    "placement_groups", "timeline", "metrics",
+    "placement_groups", "timeline", "metrics", "task_events", "logs",
 )
 
 _PAGE = """<!doctype html>
@@ -74,7 +79,8 @@ _PAGE = """<!doctype html>
 <div id="content"></div>
 <script>
 const TABS = ["status","nodes","actors","tasks","workers","objects",
-              "placement_groups","jobs","metrics","history","summary"];
+              "placement_groups","jobs","metrics","history","summary",
+              "task_events","logs"];
 let tab = location.hash.slice(1) || "status";
 const nav = document.getElementById("nav");
 TABS.forEach(t => {
@@ -94,7 +100,9 @@ function esc(s) {
 }
 function table(items) {
   if (!items || !items.length) return "<p style='margin:12px 16px'>(empty)</p>";
-  const cols = Object.keys(items[0]);
+  const cols = [];  // union across rows: heterogeneous rows keep all fields
+  for (const it of items)
+    for (const k of Object.keys(it)) if (!cols.includes(k)) cols.push(k);
   let h = "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
   for (const it of items.slice(0, 500)) {
     h += "<tr>" + cols.map(c => {
@@ -247,6 +255,31 @@ class Dashboard:
             return self._send_json(
                 req, self._call("list_state", {"kind": "metrics_history"})
             )
+        if path == "/api/log":
+            # Raw log content (?proc=<id>[&offset=N][&max_bytes=N]) —
+            # routed head -> owning node, works for exited processes too.
+            from urllib.parse import parse_qs
+
+            q = parse_qs(req.path.split("?", 1)[1] if "?" in req.path else "")
+
+            def qint(key, default):
+                try:
+                    return int(q.get(key, [default])[0])
+                except (TypeError, ValueError):
+                    return default
+
+            reply = self._call("get_log", {
+                "proc_id": (q.get("proc") or [""])[0],
+                "offset": qint("offset", -65536),
+                "max_bytes": qint("max_bytes", 65536),
+            })
+            if not reply.get("found"):
+                return self._send_json(
+                    req, {"error": reply.get("error", "log not found")},
+                    code=404,
+                )
+            return self._send(req, 200, "text/plain; charset=utf-8",
+                              bytes(reply.get("data") or b""))
         if path.startswith("/api/"):
             kind = path[len("/api/"):]
             if kind in _STATE_KINDS:

@@ -37,9 +37,9 @@ def _client(addr: Optional[str]):
     return Client(_resolve_address(addr), kind="driver", pid=os.getpid())
 
 
-def _print_table(rows, columns):
+def _print_table(rows, columns, empty: str = "(no items)"):
     if not rows:
-        print("(empty)")
+        print(empty)
         return
     widths = {
         c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
@@ -50,6 +50,18 @@ def _print_table(rows, columns):
         print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
 
 
+def _union_columns(items) -> list:
+    """Column set spanning EVERY row (first-seen order): heterogeneous
+    state rows (e.g. pending vs reserved placement groups) must not have
+    fields silently dropped because items[0] happened to lack them."""
+    cols: list = []
+    for r in items:
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+    return cols
+
+
 _LIST_COLUMNS = {
     "actors": ["actor_id", "class_name", "state", "name", "pid",
                "num_executed_tasks"],
@@ -58,6 +70,10 @@ _LIST_COLUMNS = {
     "workers": ["worker_id", "node_id", "state", "pid"],
     "objects": ["object_id", "size", "sealed", "inline", "ref_count"],
     "placement_groups": ["pg_id", "strategy", "created", "name"],
+    "logs": ["proc_id", "kind", "node_id", "pid", "alive", "actor_id",
+             "log_path"],
+    "task_events": ["task_id", "name", "state", "node_id", "worker_id",
+                    "error"],
 }
 
 
@@ -69,9 +85,8 @@ def cmd_list(args) -> int:
         if args.json:
             print(json.dumps(items, indent=1, default=str))
         else:
-            _print_table(items, _LIST_COLUMNS.get(
-                kind, sorted(items[0].keys()) if items else []
-            ))
+            _print_table(items, _LIST_COLUMNS.get(kind) or
+                         _union_columns(items), empty=f"(no {kind})")
     finally:
         cl.close()
     return 0
@@ -148,6 +163,159 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """Cluster log retrieval (reference: `ray logs`).  Without an id, lists
+    the head's log index — including EXITED processes, whose files stay
+    retrievable for crash post-mortems.  With an id (worker/node hex
+    prefix, actor id, or pid), streams that process's log; --follow keeps
+    tailing a live process."""
+    if getattr(args, "post_mortem", False):
+        return _post_mortem_tails(args)
+    cl = _client(args.address)
+    try:
+        if not args.id:
+            items = cl.call("list_state", {"kind": "logs"})["items"]
+            _print_table(items, _LIST_COLUMNS["logs"],
+                         empty="(no registered logs)")
+            return 0
+        from .core.api import iter_log_chunks
+
+        try:
+            for data in iter_log_chunks(
+                cl.call, args.id, offset=-args.tail if args.tail else 0,
+                follow=args.follow,
+            ):
+                sys.stdout.write(data.decode("utf-8", "replace"))
+                sys.stdout.flush()
+        except RuntimeError as e:
+            print(e, file=sys.stderr)
+            return 1
+    finally:
+        cl.close()
+    return 0
+
+
+def _post_mortem_tails(args) -> int:
+    """Dump the tail of every cluster process log — CI calls this when the
+    test run fails so failures come with worker-side post-mortems.  Routes
+    through the head's log index when a cluster is reachable; falls back
+    to scanning the log root on the local filesystem."""
+    import glob
+
+    tail = args.tail or 4000
+    paths: list = []
+    try:
+        cl = _client(args.address)
+        try:
+            paths = [e["log_path"] for e
+                     in cl.call("list_state", {"kind": "logs"})["items"]
+                     if e.get("log_path")]
+        finally:
+            cl.close()
+    except (SystemExit, Exception):
+        pass  # no live cluster: the filesystem fallback below still works
+    if not paths:
+        from .core.node_main import LOG_ROOT
+
+        paths = sorted(
+            glob.glob(os.path.join(LOG_ROOT, "*", "*.log")),
+            key=lambda p: os.path.getmtime(p) if os.path.exists(p) else 0,
+        )
+    shown = 0
+    for path in paths[-40:]:
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - tail))
+                data = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        if not data.strip():
+            continue
+        print(f"==== {path} (last {min(size, tail)} bytes) ====")
+        print(data)
+        shown += 1
+    if not shown:
+        print("(no cluster process logs found)")
+    return 0
+
+
+def cmd_events(args) -> int:
+    """Task lifecycle history (reference: `ray list tasks --detail` / the
+    task events state API): per-task SUBMITTED/SCHEDULED/RUNNING/FINISHED/
+    FAILED transitions with placement and failure tracebacks, retained at
+    the head past worker/node death."""
+    cl = _client(args.address)
+    try:
+        body = {"kind": "task_events"}
+        if args.task:
+            body["task_id"] = args.task
+        if args.errors:
+            body["errors"] = True
+        items = cl.call("list_state", body)["items"]
+        if args.json:
+            print(json.dumps(items, indent=1, default=str))
+            return 0
+        if args.task:
+            if not items:
+                print(f"(no task events for {args.task!r})")
+                return 0
+            for rec in items:
+                print(f"task {rec['task_id']}  name={rec.get('name', '')}  "
+                      f"state={rec.get('state', '')}")
+                for ev in rec.get("events", []):
+                    where = " ".join(
+                        f"{k}={ev[k]}" for k in ("node", "worker", "error")
+                        if ev.get(k)
+                    )
+                    print(f"  {ev.get('ts', 0):.6f}  "
+                          f"{ev.get('state', ''):<10} {where}")
+                if rec.get("traceback"):
+                    print("  traceback:")
+                    for line in str(rec["traceback"]).splitlines():
+                        print(f"    {line}")
+            return 0
+        rows = [
+            {
+                "task_id": r["task_id"][:16],
+                "name": r.get("name", ""),
+                "state": r.get("state", ""),
+                "node_id": (r.get("node_id") or "")[:8],
+                "worker_id": (r.get("worker_id") or "")[:8],
+                "error": " ".join(str(r.get("error") or "").split())[:60],
+            }
+            for r in items
+        ]
+        _print_table(rows, _LIST_COLUMNS["task_events"],
+                     empty="(no task events)")
+    finally:
+        cl.close()
+    return 0
+
+
+def cmd_stack(args) -> int:
+    """On-demand all-thread stack dump of a live worker (reference:
+    `ray stack`): the hung-gang diagnosis tool — collected by the worker's
+    rpc thread without interrupting the running task."""
+    cl = _client(args.address)
+    try:
+        reply = cl.call(
+            "stack_dump",
+            {"worker_id": args.worker_id, "timeout": args.timeout},
+            timeout=args.timeout + 30,
+        )
+    finally:
+        cl.close()
+    if not reply.get("found") or not reply.get("ok"):
+        print(reply.get("error", "stack dump failed"), file=sys.stderr)
+        return 1
+    print(f"worker {reply['worker_id'][:16]} pid={reply.get('pid')} "
+          f"node={reply.get('node_id', '')[:8]} "
+          f"threads={reply.get('threads')}")
+    print(reply.get("dump", ""))
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Declarative Serve operations (reference: `serve deploy/status/
     shutdown` CLI over the schema config)."""
@@ -200,10 +368,43 @@ def main(argv=None) -> int:
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", choices=[
         "actors", "tasks", "nodes", "workers", "objects",
-        "placement_groups", "pgs",
+        "placement_groups", "pgs", "logs", "task_events",
     ])
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser(
+        "logs", help="cluster log index / per-process log retrieval"
+    )
+    p.add_argument("id", nargs="?", default=None,
+                   help="worker/node id (hex prefix), actor id, or pid; "
+                        "omit to list the index")
+    p.add_argument("--follow", action="store_true",
+                   help="keep tailing a live process")
+    p.add_argument("--tail", type=int, default=0, metavar="BYTES",
+                   help="start BYTES from the end of the log")
+    p.add_argument("--post-mortem", action="store_true",
+                   help="dump tails of every cluster process log "
+                        "(index-routed, filesystem fallback) — for CI "
+                        "failure forensics")
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("events", help="task lifecycle event history")
+    p.add_argument("--task", default=None,
+                   help="show full transitions for tasks matching this id "
+                        "prefix")
+    p.add_argument("--errors", action="store_true",
+                   help="only failed tasks")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
+        "stack", help="dump all-thread Python stacks of a live worker"
+    )
+    p.add_argument("worker_id",
+                   help="worker id (hex prefix) or actor id")
+    p.add_argument("--timeout", type=float, default=15.0)
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("status", help="cluster resource summary")
     p.set_defaults(fn=cmd_status)

@@ -20,4 +20,13 @@ rc=${PIPESTATUS[0]}
 
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" \
     | tr -cd . | wc -c)"
+
+if [ "$rc" -ne 0 ]; then
+    # Failure forensics: tail every cluster process log (worker/daemon
+    # side) so CI failures come with post-mortems.  Routes through the
+    # head's log index when a cluster is still up; otherwise falls back to
+    # scanning /tmp/ray_tpu_logs on this machine.
+    echo "=== cluster process log tails (tier-1 run failed, rc=$rc) ==="
+    python -m ray_tpu logs --post-mortem --tail 4000 || true
+fi
 exit "$rc"
