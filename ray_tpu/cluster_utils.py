@@ -74,6 +74,23 @@ class Cluster:
         autoscaler's LocalNodeProvider."""
         self = cls.__new__(cls)
         self.head_addr = head_addr
+        # Fail fast on a bad address: a wrong/stale head_addr would
+        # otherwise construct fine and only surface minutes later as the
+        # first add_node timing out.
+        from ray_tpu.core.rpc import RpcClient
+
+        host, _, port = head_addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"cluster address {head_addr!r} is not host:port — "
+                "attach() needs the head's control-plane address "
+                "(RT_ADDRESS / the value init() printed)"
+            )
+        probe = RpcClient(host, int(port), name="attach-probe")
+        try:
+            probe.call("ping", {}, timeout=10.0)
+        finally:
+            probe.close()
         from ray_tpu.core.context import ctx
 
         self.head_node_id = ctx.client.node_id if ctx.client else None

@@ -21,6 +21,7 @@ from .config import get_config
 from .ids import NodeID, ObjectID
 from .object_store import StoreClient
 from .rpc import ConnectionLost, RpcClient
+from ..devtools.locks import make_lock
 
 # Head RPCs that are safe to retry on a transient connection hiccup: pure
 # reads (no head-side state mutation), so a duplicate delivery is harmless
@@ -95,21 +96,21 @@ class Client:
         self._local: "OrderedDict[ObjectID, bytes]" = OrderedDict()
         self._local_bytes = 0
         self._local_cap = get_config().local_store_max_bytes
-        self._local_lock = threading.Lock()
+        self._local_lock = make_lock("client.local_store")
         # In-flight fire-and-forget RPCs (registrations, submissions): a
         # bounded pipeline so submission throughput isn't gated on one
         # round trip per call (reference: task submission is async; errors
         # surface on the returned ref).
         self._bg_futs: deque = deque()
-        self._bg_lock = threading.Lock()
+        self._bg_lock = make_lock("client.bg_pipeline")
         self._bg_exc: Optional[BaseException] = None
         # Buffered inline-object registrations (flushed as one RPC before
         # any other outbound call — see _flush_put_batch).
         self._put_batch: List[dict] = []
-        self._put_batch_lock = threading.Lock()
+        self._put_batch_lock = make_lock("client.put_batch")
         # Buffered fire-and-forget calls (see call_batched).
         self._submit_batch: List[dict] = []
-        self._submit_batch_lock = threading.Lock()
+        self._submit_batch_lock = make_lock("client.submit_batch")
         # Function-table keys this process has already exported (api._export).
         self.exported_keys: set = set()
         # Object ids of large (shm) objects this process put: their frees
@@ -118,17 +119,17 @@ class Client:
         self.large_oids: set = set()
         self._last_large_free = 0.0
         self._sub_handlers: Dict[str, List[Callable]] = {}
-        self._sub_lock = threading.Lock()
+        self._sub_lock = make_lock("client.pubsub")
         # Connections to other nodes' object-plane (pull) servers.
         self._pull_conns: Dict[str, RpcClient] = {}
         self._bulk_conns: Dict[str, tuple] = {}
-        self._pull_lock = threading.Lock()
+        self._pull_lock = make_lock("client.pull_conns")
         self.rpc.on_push("pubsub", self._on_pubsub)
         self.rpc.on_push("object_free", self._on_object_free)
         # Free-queue flusher: ObjectRef.__del__ only appends + signals (it
         # may run from cyclic GC inside a client critical section, so it
         # must never take client locks itself); this thread does the RPCs.
-        self._reconnect_lock = threading.Lock()
+        self._reconnect_lock = make_lock("client.reconnect")
         self._free_flusher = threading.Thread(
             target=self._free_flush_loop, daemon=True, name="free-flusher"
         )
@@ -615,7 +616,7 @@ class Client:
         host, port = addr.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=30)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        entry = (sock, threading.Lock())
+        entry = (sock, make_lock("client.bulk_conn"))
         with self._pull_lock:
             racer = self._bulk_conns.get(addr)
             if racer is not None:

@@ -33,6 +33,7 @@ from .config import Config
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .rpc import Connection, RpcServer
 from .scheduler import ClusterScheduler, SchedulingStrategy
+from ..devtools.locks import make_lock
 
 # Worker / actor / task states (subset of the reference FSMs:
 # gcs_actor_manager.h actor FSM, worker_pool.h worker states).
@@ -238,7 +239,7 @@ class Head:
         self.worker_procs: List[subprocess.Popen] = []
         self.worker_pids: List[int] = []  # zygote-forked (init reaps them)
         self._zygote = None
-        self._zygote_mutex = threading.Lock()
+        self._zygote_mutex = make_lock("head.zygote")
         self.node_daemons: Dict[NodeID, Connection] = {}
         # Object-plane server address per node (chunked pull endpoint).
         self.node_object_addrs: Dict[NodeID, str] = {}
@@ -340,7 +341,7 @@ class Head:
             "publish", "subscribe", "cluster_resources", "available_resources",
             "next_stream_item", "list_state", "object_sizes",
             "ping", "shutdown_cluster",
-            "actor_restarting", "restore_object", "store_stats",
+            "restore_object", "store_stats",
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
             "node_health_ack", "node_stats", "node_drain", "span",
             "get_log", "stack_dump", "stack_dump_reply",
@@ -773,18 +774,23 @@ class Head:
             self.persist_state()
         except Exception:
             pass
+        self._shutdown = True
         # Sweep this session's node-local fn-table cache (workers populate
-        # it under /tmp/ray_tpu_fncache/<session>).
+        # it under /tmp/ray_tpu_fncache/<session>).  Off-loop: a large
+        # cache tree would stall the final pushes/acks below (RT001) —
+        # and after the shutdown flag, so nothing new interleaves in.
         try:
             import shutil
 
-            shutil.rmtree(
-                os.path.join("/tmp/ray_tpu_fncache", self.session),
-                ignore_errors=True,
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: shutil.rmtree(
+                    os.path.join("/tmp/ray_tpu_fncache", self.session),
+                    ignore_errors=True,
+                ),
             )
         except Exception:
             pass
-        self._shutdown = True
         if self._periodic_task is not None:
             self._periodic_task.cancel()
         if self._tick_task is not None:
@@ -1234,10 +1240,16 @@ class Head:
         path = self.config.head_state_path
         if not path or not os.path.exists(path):
             return
-        import cloudpickle
+        # Disk read + unpickle off-loop: a multi-MB snapshot parsed on the
+        # loop would block the very first registrations after a restart
+        # (RT001 — the handlers-never-block contract applies at boot too).
+        def _load():
+            import cloudpickle
 
-        with open(path, "rb") as f:
-            state = cloudpickle.loads(f.read())
+            with open(path, "rb") as f:
+                return cloudpickle.loads(f.read())
+
+        state = await asyncio.get_running_loop().run_in_executor(None, _load)
         self.kv.update(state.get("kv", {}))
         # Event history first, so restart markers sort after it.
         for ev in state.get("task_events", []):
@@ -2680,9 +2692,6 @@ class Head:
                 await self._fail_actor_queue(actor, None)
                 self._free_actor_creation_args(actor)
         return {"killed": True}
-
-    async def h_actor_restarting(self, conn, body):
-        return {}
 
     async def h_worker_ready(self, conn, body):
         worker_id = self.conn_to_worker.get(conn.conn_id)

@@ -13,6 +13,8 @@ from __future__ import annotations
 import os
 import threading
 
+from ..devtools.locks import make_lock
+
 _ID_LEN = 16  # bytes of entropy per ID
 _OBJECT_INDEX_LEN = 4  # trailing bytes of an ObjectID encode the return index
 
@@ -128,7 +130,7 @@ class _Counter:
 
     def __init__(self):
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("ids.counter")
 
     def next(self) -> int:
         with self._lock:

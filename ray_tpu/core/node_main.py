@@ -201,9 +201,18 @@ def read_log_range(path: str, offset=0, max_bytes=65536) -> dict:
 
 def make_log_read_handler():
     """`read_log` for a node's RPC server: the head routes `get_log` calls
-    for this node's processes here (head -> owning node -> file)."""
+    for this node's processes here (head -> owning node -> file).  Like
+    the pull handler, validates its own schema row — node servers sit
+    outside the head's ``_validated`` wrapper."""
 
     async def h_read_log(conn, body):
+        from . import schema as wire_schema
+        from .rpc import RpcError
+
+        try:
+            wire_schema.validate("read_log", body)
+        except wire_schema.SchemaError as e:
+            raise RpcError(str(e)) from None
         return read_log_range(
             body.get("path", ""), body.get("offset", 0),
             body.get("max_bytes", 65536),
@@ -214,9 +223,19 @@ def make_log_read_handler():
 
 def make_pull_handler(store: ObjectStore):
     """Chunked object reads from a node store.  Shared by the node daemon and
-    the head (which serves its own local node's objects)."""
+    the head (which serves its own local node's objects).  Validates its own
+    schema row: pull servers register outside the head's ``_validated``
+    wrapper, and the boundary guarantee must hold on every server that
+    speaks the method."""
 
     async def h_pull_object(conn, body):
+        from . import schema as wire_schema
+        from .rpc import RpcError
+
+        try:
+            wire_schema.validate("pull_object", body)
+        except wire_schema.SchemaError as e:
+            raise RpcError(str(e)) from None
         oid = ObjectID(body["object_id"])
         view = store.get(oid)  # restores from spill if needed
         if view is None:

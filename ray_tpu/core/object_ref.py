@@ -13,13 +13,14 @@ from typing import Optional
 from . import serialization
 from .context import ctx
 from .ids import ObjectID
+from ..devtools.locks import make_lock
 
 # Batched free queue: ObjectRef.__del__ must never block on RPC — and must
 # never call into Client methods at all: __del__ can run from cyclic GC
 # inside a client critical section, so taking any client lock here can
 # self-deadlock.  __del__ only appends and signals; the client's flusher
 # thread does the actual work.
-_free_lock = threading.Lock()
+_free_lock = make_lock("objectref.free_queue")
 _free_queue: list = []
 flush_wanted = threading.Event()
 

@@ -26,6 +26,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from .ids import ObjectID
+from ..devtools.locks import make_lock, make_rlock
 
 _SHM_DIR = "/dev/shm"
 _PREFIX = "rtpu"
@@ -147,7 +148,7 @@ class ObjectStore:
         # Freed segments up to this many bytes stay pooled (pages warm) for
         # reuse by the next writer; beyond it they are unlinked.
         self._pool_cap = min(capacity_bytes // 2, 4 * 1024**3)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.daemon")
         # Sealed objects in shm, LRU order (oldest first).
         self._objects: "OrderedDict[ObjectID, _Segment]" = OrderedDict()
         self._spilled: Dict[ObjectID, str] = {}
@@ -405,7 +406,7 @@ class StoreClient:
     def __init__(self, session: str):
         self._session = session
         self._attached: Dict[ObjectID, _Segment] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.client_attach")
 
     def create(self, object_id: ObjectID, size: int,
                wait_pool_s: float = 0.0) -> memoryview:

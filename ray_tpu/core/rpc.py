@@ -20,6 +20,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
+from ..devtools.locks import make_lock
+
 REQ, RESP, ERR, PUSH = 0, 1, 2, 3
 _HDR = struct.Struct("<I")
 
@@ -191,7 +193,7 @@ class RpcClient:
         )
         self._thread.start()
         self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = make_lock("rpc.seq")
         self._pending: Dict[int, asyncio.Future] = {}
         self._push_handlers: Dict[str, Callable[[Any], None]] = {}
         self._writer = None
@@ -202,7 +204,16 @@ class RpcClient:
         from .config import get_config
 
         fut = asyncio.run_coroutine_threadsafe(self._connect(), self._loop)
-        fut.result(timeout=get_config().rpc_connect_timeout_s)
+        try:
+            fut.result(timeout=get_config().rpc_connect_timeout_s)
+        except BaseException:
+            # A failed dial must not leak the loop thread started above:
+            # callers that probe-and-retry (Cluster.attach fail-fast,
+            # reconnect loops) would accumulate one live thread + event
+            # loop per attempt.  close() is null-safe pre-connect.
+            fut.cancel()
+            self.close()
+            raise
 
     async def _connect(self):
         self._reader, self._writer = await asyncio.open_connection(

@@ -42,7 +42,10 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "put_object_batch": (("objects", list),),
     "proxy_put": (("object_id", _BYTES), ("total", _NUM), ("offset", _NUM),
                   ("data", _BYTES)),
+    "object_free_ack": (("token", _NUM),),
     "get_objects": (("object_ids", list),),
+    "next_stream_item": (("task_id", _BYTES), ("index", _NUM)),
+    "pull_object": (("object_id", _BYTES),),
     "wait_objects": (("object_ids", list),),
     "object_sizes": (("object_ids", list),),
     "free_objects": (("object_ids", list),),
@@ -67,6 +70,14 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "node_stats": (("node_id", _BYTES),),
     "node_drain": (("node_id", _BYTES),),
     "span": (("trace_id", str), ("span_id", str), ("name", str)),
+    "metrics_report": (("pid", _NUM), ("rows", list)),
+    "pg_ready": (("pg_id", _BYTES),),
+    "read_log": (("path", str),),
+    # Methods whose bodies carry no required fields still get a row: the
+    # floor "body is a map" check applies, and rtlint RT003 treats a row
+    # as the declaration that the method's wire shape is owned here.
+    "worker_ready": (),
+    "shutdown_cluster": (),
     "restore_object": (("object_id", _BYTES),),
     "get_log": (("proc_id", str),),
     "stack_dump": (("worker_id", str),),

@@ -33,6 +33,7 @@ import cloudpickle
 
 from .. import exceptions
 from . import serialization
+from ..devtools.locks import make_lock
 from .client import Client
 from .config import get_config
 from .context import ctx
@@ -52,7 +53,7 @@ class _LogTee:
         self._client = client
         self._kind = kind
         self._buf = ""
-        self._buf_lock = threading.Lock()
+        self._buf_lock = make_lock("worker.log_tee")
         self._local = threading.local()
         # Own in-flight window: log lines must never poison the client's
         # shared bg-error channel or block a task — past the window they

@@ -26,6 +26,8 @@ import sys
 import threading
 from typing import Dict, Optional, Sequence
 
+from ..devtools.locks import make_lock
+
 
 def _set_comm(name: str):
     """Set the kernel thread name (prctl PR_SET_NAME) so zygote-forked
@@ -120,7 +122,7 @@ class Zygote:
             env=env,
             text=True,
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("zygote.proc")
 
     def alive(self) -> bool:
         return self.proc.poll() is None

@@ -1,0 +1,34 @@
+"""Developer tooling for the ray_tpu control plane.
+
+Two complementary halves (the protections the reference gets from its
+protobuf schemas + C++ sanitizer CI — reference: src/ray/protobuf/*.proto,
+TSAN/ASAN jobs — rebuilt for a msgpack-dict, pure-Python control plane):
+
+- **rtlint** (`python -m ray_tpu lint`, :mod:`ray_tpu.devtools.rtlint`):
+  AST-based static analysis that knows this framework's idioms — blocking
+  calls inside the head's async handlers, threading locks held across an
+  ``await``, client-call/handler/schema drift on the RPC surface, nested
+  ``ray_tpu.get`` in remote functions, undaemonized threads, metric-name
+  drift.  Rules RT001–RT006; vetted exceptions live in ``ray_tpu/.rtlint-allowlist``.
+- **lock sentinel** (:mod:`ray_tpu.devtools.locks`): an opt-in
+  (``RT_DEBUG_LOCKS=1``) instrumented lock used by ``core/`` that records
+  per-thread acquisition order, asserts one consistent global lock
+  ordering, and logs locks held past a threshold — the dynamic complement
+  to rule RT002.
+"""
+
+from __future__ import annotations
+
+
+def __getattr__(name):
+    # Lazy: importing ray_tpu.devtools.locks from core/ at startup must not
+    # drag the whole lint engine in.
+    if name in ("run_lint", "Finding", "main"):
+        from . import rtlint
+
+        return getattr(rtlint, name)
+    if name in ("make_lock", "make_rlock", "LockOrderError"):
+        from . import locks
+
+        return getattr(locks, name)
+    raise AttributeError(name)

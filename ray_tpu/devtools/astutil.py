@@ -1,0 +1,194 @@
+"""Shared AST plumbing for the rtlint rules.
+
+Everything here is dependency-free stdlib ``ast`` work: rules must stay
+importable (and runnable over a scratch tree) without initializing any of
+the framework's runtime machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None (calls, subscripts
+    and other dynamic receivers don't have a static dotted form)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {
+        child: parent
+        for parent in ast.walk(tree)
+        for child in ast.iter_child_nodes(parent)
+    }
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.AST]:
+    """Every function/async-function definition in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            yield node
+
+
+def walk_own_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body EXCLUDING nested function/class definitions:
+    a nested ``def`` has its own execution context (it may run in an
+    executor, a thread, or never), so its statements are not attributable
+    to the enclosing function's thread/loop."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNC_NODES + (ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def contains_await(node: ast.AST) -> bool:
+    """True if the node's own body (nested defs excluded) awaits."""
+    return any(isinstance(n, ast.Await) for n in walk_own_body(node))
+
+
+def is_awaited(call: ast.Call, parents: Dict[ast.AST, ast.AST]) -> bool:
+    return isinstance(parents.get(call), ast.Await)
+
+
+def decorator_names(node: ast.AST) -> List[str]:
+    """Dotted names of a def/class's decorators; ``@d(...)`` reports the
+    callee ``d``."""
+    out: List[str] = []
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name:
+            out.append(name)
+    return out
+
+
+def assigned_target(call: ast.Call,
+                    parents: Dict[ast.AST, ast.AST]) -> Optional[str]:
+    """Terminal name a call's result is bound to (``x = f()`` -> ``x``,
+    ``self.x = f()`` -> ``x``), else None."""
+    parent = parents.get(call)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        t = parent.targets[0]
+        if isinstance(t, ast.Name):
+            return t.id
+        if isinstance(t, ast.Attribute):
+            return t.attr
+    return None
+
+
+def str_dict_literal(tree: ast.AST, var: str) -> Optional[Dict[str, str]]:
+    """Parse a module-level ``var = {"k": "v", ...}`` assignment without
+    importing the module."""
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        if any(isinstance(t, ast.Name) and t.id == var for t in targets):
+            if not isinstance(node.value, ast.Dict):
+                return None
+            out: Dict[str, str] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                ks, vs = const_str(k), const_str(v)
+                if ks is not None and vs is not None:
+                    out[ks] = vs
+            return out
+    return None
+
+
+def str_collection_literal(tree: ast.AST, var: str) -> Optional[List[str]]:
+    """String constants inside a module-level ``var = frozenset({...})`` /
+    set / tuple / list / dict-keys assignment, without importing."""
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == var for t in node.targets
+        ):
+            return [
+                n.value for n in ast.walk(node.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            ]
+    return None
+
+
+def enclosing_functions(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> List[ast.AST]:
+    """Function defs lexically enclosing ``node``, innermost first."""
+    out: List[ast.AST] = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, _FUNC_NODES):
+            out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def local_names(fn: ast.AST) -> set:
+    """Parameter + locally-bound names of a function (its own body only)."""
+    args = fn.args
+    names = {
+        a.arg
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        )
+    }
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in walk_own_body(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+    # Direct child defs/classes bind their names in this scope too.
+    for child in ast.iter_child_nodes(fn):
+        if isinstance(child, _FUNC_NODES + (ast.ClassDef,)):
+            names.add(child.name)
+    return names
+
+
+def module_scope_names(tree: ast.AST) -> set:
+    """Names bound at MODULE scope only — nested function/class bodies are
+    excluded (their Store names are locals, and treating them as module
+    globals would mask closure captures)."""
+    names = set()
+    for node in walk_own_body(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+    for child in ast.iter_child_nodes(tree):
+        if isinstance(child, _FUNC_NODES + (ast.ClassDef,)):
+            names.add(child.name)
+    return names

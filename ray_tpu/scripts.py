@@ -107,9 +107,45 @@ def cmd_status(args) -> int:
         for res in sorted(total):
             used = total[res] - avail.get(res, 0)
             print(f"  {res}: {used:g}/{total[res]:g} used")
+        stats = cl.call("store_stats")
+        used_b = stats.get("used_bytes", 0)
+        cap_b = stats.get("capacity_bytes", 0)
+        print(f"object store: {used_b / 2**20:.1f}/{cap_b / 2**20:.1f} "
+              "MiB used (head node)")
     finally:
         cl.close()
     return 0
+
+
+def cmd_down(args) -> int:
+    """Shut the whole cluster down over the control plane (reference:
+    `ray stop`): the head tears down workers, node daemons and itself."""
+    cl = _client(args.address)
+    try:
+        cl.call("shutdown_cluster", {})
+        print("cluster shutdown requested")
+    finally:
+        try:
+            cl.close()
+        except Exception:
+            pass  # the head is going away under us by design
+    return 0
+
+
+def cmd_lint(args) -> int:
+    """rtlint: framework-aware static analysis over the ray_tpu package
+    (rules RT001-RT006; see ray_tpu/devtools/rtlint.py).  Needs no
+    running cluster."""
+    from .devtools import rtlint
+
+    argv = []
+    if args.json:
+        argv.append("--json")
+    if args.root:
+        argv += ["--root", args.root]
+    if args.allowlist:
+        argv += ["--allowlist", args.allowlist]
+    return rtlint.main(argv)
 
 
 def cmd_summary(args) -> int:
@@ -408,6 +444,22 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("status", help="cluster resource summary")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("down", help="shut the cluster down")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser(
+        "lint", help="framework-aware static analysis (RT001-RT006)"
+    )
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    p.add_argument("--root", default=None,
+                   help="package directory to lint (default: this "
+                        "installed ray_tpu package)")
+    p.add_argument("--allowlist", default=None,
+                   help="allowlist file (default: the package's own "
+                        ".rtlint-allowlist)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("summary", help="task summary by name+state")
     p.set_defaults(fn=cmd_summary)

@@ -19,6 +19,46 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+#: Catalog of every built-in ``ray_tpu_*`` metric the framework emits,
+#: name -> kind.  This is the contract operators wire dashboards and
+#: alerts against; rtlint rule RT006 asserts the package's emitters and
+#: this catalog agree (an uncataloged emission is invisible
+#: infrastructure, a row nothing emits is a panel that never populates).
+#: Adding a built-in metric means adding its row here in the same PR.
+BUILTIN_METRICS: Dict[str, str] = {
+    # scheduler / tasks (core/telemetry.py)
+    "ray_tpu_scheduler_submit_to_start_seconds": "histogram",
+    "ray_tpu_scheduler_queue_depth": "gauge",
+    "ray_tpu_scheduler_tasks_dispatched_total": "counter",
+    "ray_tpu_task_duration_seconds": "histogram",
+    # object store (core/telemetry.py)
+    "ray_tpu_object_store_used_bytes": "gauge",
+    "ray_tpu_object_store_capacity_bytes": "gauge",
+    "ray_tpu_object_store_bytes_stored_total": "gauge",
+    "ray_tpu_object_store_bytes_transferred_total": "gauge",
+    "ray_tpu_object_store_hit_rate": "gauge",
+    # train goodput (train/telemetry.py)
+    "ray_tpu_train_step_seconds": "gauge",
+    "ray_tpu_train_tokens_per_sec": "gauge",
+    "ray_tpu_train_mfu": "gauge",
+    "ray_tpu_train_compile_seconds": "gauge",
+    # serve (serve/replica.py, serve/batching.py, serve/handle.py)
+    "ray_tpu_serve_request_latency_seconds": "histogram",
+    "ray_tpu_serve_replica_queue_depth": "gauge",
+    "ray_tpu_serve_batch_size": "histogram",
+    "ray_tpu_serve_batch_queue_depth": "gauge",
+    "ray_tpu_serve_replica_retries_total": "counter",
+    # data (data/dataset.py)
+    "ray_tpu_data_rows_total": "counter",
+    "ray_tpu_data_stage_seconds_total": "counter",
+    "ray_tpu_data_rows_per_sec": "gauge",
+    # autoscaler (autoscaler/__init__.py)
+    "ray_tpu_autoscaler_demand": "gauge",
+    "ray_tpu_autoscaler_decisions_total": "counter",
+    # logging plane (core/worker_main.py)
+    "ray_tpu_logs_dropped_total": "counter",
+}
+
 _registry_lock = threading.Lock()
 _instruments: List["_Metric"] = []
 _named: Dict[Tuple[str, str], "_Metric"] = {}  # (kind, name) -> instrument

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# rtlint gate: framework-aware static analysis over the ray_tpu package
+# (rules RT001-RT006; engine in ray_tpu/devtools/rtlint.py, vetted
+# exceptions in .rtlint-allowlist).  Non-zero exit on any unallowlisted
+# finding — scripts/verify.sh runs this before pytest so drift never
+# reaches the test stage.
+#
+# Usage: scripts/lint.sh [--json] [rtlint args...]
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+exec python -m ray_tpu lint "$@"

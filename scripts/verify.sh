@@ -12,6 +12,15 @@ LOG="${T1_LOG:-/tmp/_t1.log}"
 TIMEOUT="${T1_TIMEOUT:-870}"
 rm -f "$LOG"
 
+# Static analysis first: rtlint (RT001-RT006) is cheap (~1s) and a drift
+# finding fails faster and more precisely than the test breakage it
+# foreshadows.  scripts/lint.sh exits non-zero on unallowlisted findings.
+if ! scripts/lint.sh; then
+    echo "rtlint failed — fix the findings above (or justify them in"
+    echo ".rtlint-allowlist) before running tests"
+    exit 1
+fi
+
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \

@@ -1,0 +1,226 @@
+"""Runtime concurrency sentinel (ray_tpu/devtools/locks.py).
+
+The dynamic complement to rtlint RT002 — opt-in via ``RT_DEBUG_LOCKS=1``,
+asserting one consistent global lock ordering and logging long holds.
+Disabled (the default), ``make_lock`` must hand back a plain
+``threading.Lock``: the control plane's hot paths pay zero wrapper cost.
+"""
+
+import logging
+import threading
+
+import pytest
+
+from ray_tpu.devtools import locks
+from ray_tpu.devtools.locks import (LockOrderError, SentinelLock, make_lock,
+                                    make_rlock, reset_sentinel_state)
+
+
+@pytest.fixture
+def sentinel_on(monkeypatch):
+    monkeypatch.setenv("RT_DEBUG_LOCKS", "1")
+    reset_sentinel_state()
+    yield
+    reset_sentinel_state()
+
+
+class TestDisabledPath:
+    def test_plain_lock_when_disabled(self, monkeypatch):
+        monkeypatch.delenv("RT_DEBUG_LOCKS", raising=False)
+        lk = make_lock("x")
+        # The zero-overhead contract: not a wrapper, the raw primitive.
+        assert type(lk) is type(threading.Lock())
+        rl = make_rlock("x")
+        assert type(rl) is type(threading.RLock())
+
+    def test_disabled_unless_exactly_one(self, monkeypatch):
+        monkeypatch.setenv("RT_DEBUG_LOCKS", "0")
+        assert type(make_lock("x")) is type(threading.Lock())
+
+
+class TestOrdering:
+    def test_consistent_order_passes(self, sentinel_on):
+        a, b = make_lock("A"), make_lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+
+    def test_inversion_raises(self, sentinel_on):
+        a, b = make_lock("A"), make_lock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderError, match="inversion"):
+                a.acquire()
+
+    def test_inversion_detected_across_threads(self, sentinel_on):
+        a, b = make_lock("A"), make_lock("B")
+
+        def establish():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=establish)
+        t.start()
+        t.join()
+        errors = []
+
+        def invert():
+            try:
+                with b:
+                    with a:
+                        pass
+            except LockOrderError as e:
+                errors.append(e)
+
+        t = threading.Thread(target=invert)
+        t.start()
+        t.join()
+        assert len(errors) == 1
+
+    def test_transitive_cycle_detected(self, sentinel_on):
+        # Global ordering means NO cycle through the edge graph — a
+        # three-lock cycle (A->B, B->C, then A-under-C) deadlocks just as
+        # surely as ABBA and must raise even though no direct C->A edge
+        # was ever inverted.
+        a, b, c = make_lock("A"), make_lock("B"), make_lock("C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with pytest.raises(LockOrderError, match="cyclic"):
+                a.acquire()
+
+    def test_same_instance_reacquire_raises(self, sentinel_on):
+        lk = make_lock("solo")
+        with lk:
+            with pytest.raises(LockOrderError, match="re-acquiring"):
+                lk.acquire()
+
+    def test_rlock_reentry_allowed(self, sentinel_on):
+        rl = make_rlock("re")
+        with rl:
+            with rl:
+                pass
+
+    def test_error_names_real_call_sites(self, sentinel_on):
+        # The message must point at THIS test file, not the wrapper's
+        # internals — that's what an operator goes and looks at.
+        a, b = make_lock("A"), make_lock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderError) as ei:
+                a.acquire()
+        assert "test_devtools_locks.py" in str(ei.value)
+        assert "devtools/locks.py" not in str(ei.value)
+
+    def test_try_lock_backoff_records_no_edge(self, sentinel_on):
+        # Try-lock-with-back-off cannot deadlock, so a failed OR successful
+        # non-blocking acquire must not establish an ordering edge that a
+        # later legitimate opposite-order blocking acquisition trips over.
+        a, b = make_lock("A"), make_lock("B")
+        with a:
+            assert b.acquire(blocking=False)
+            b.release()
+        with b:
+            with a:  # opposite blocking order: still fine
+                pass
+
+    def test_peer_instances_of_one_role_unordered(self, sentinel_on):
+        # Two Clients each own a "client.pubsub" lock; holding one while
+        # taking the other (e.g. relaying between sessions) must not
+        # self-invert the name class.
+        l1, l2 = make_lock("client.pubsub"), make_lock("client.pubsub")
+        with l1:
+            with l2:
+                pass
+        with l2:
+            with l1:
+                pass
+
+
+class TestHoldLogging:
+    def test_long_hold_logged(self, sentinel_on, monkeypatch, caplog):
+        monkeypatch.setenv("RT_DEBUG_LOCKS_HOLD_S", "0.0")
+        lk = make_lock("slowpoke")
+        with caplog.at_level(logging.WARNING, logger="ray_tpu.locks"):
+            with lk:
+                pass
+        assert any("slowpoke" in r.message for r in caplog.records)
+
+    def test_fast_hold_not_logged(self, sentinel_on, monkeypatch, caplog):
+        monkeypatch.setenv("RT_DEBUG_LOCKS_HOLD_S", "30")
+        lk = make_lock("quick")
+        with caplog.at_level(logging.WARNING, logger="ray_tpu.locks"):
+            with lk:
+                pass
+        assert not caplog.records
+
+
+class TestWrapperProtocol:
+    def test_is_sentinel_when_enabled(self, sentinel_on):
+        assert isinstance(make_lock("x"), SentinelLock)
+
+    def test_nonblocking_acquire(self, sentinel_on):
+        lk = make_lock("nb")
+        assert lk.acquire(blocking=False)
+        try:
+            got = []
+            t = threading.Thread(
+                target=lambda: got.append(lk.acquire(blocking=False)))
+            t.start()
+            t.join()
+            assert got == [False]
+        finally:
+            lk.release()
+
+    def test_failed_acquire_not_recorded_as_held(self, sentinel_on):
+        lk = make_lock("nb2")
+        with lk:
+            t = threading.Thread(target=lambda: lk.acquire(blocking=False))
+            t.start()
+            t.join()
+        # The failed acquire must not have polluted any thread's held
+        # stack: a later acquisition in this thread sees a clean state.
+        with lk:
+            pass
+
+    def test_locked(self, sentinel_on):
+        lk = make_lock("q")
+        assert not lk.locked()
+        with lk:
+            assert lk.locked()
+
+
+class TestCoreIntegration:
+    def test_core_locks_are_sentinels_when_enabled(self):
+        # core/ builds its locks through make_lock: under RT_DEBUG_LOCKS=1
+        # a fresh interpreter's core locks come up instrumented.  Run in a
+        # subprocess — the flag is read at lock-creation (import) time and
+        # this suite's own modules are already imported plain.
+        import os
+        import subprocess
+        import sys
+
+        code = (
+            "from ray_tpu.core import object_ref\n"
+            "from ray_tpu.devtools.locks import SentinelLock\n"
+            "assert isinstance(object_ref._free_lock, SentinelLock), "
+            "type(object_ref._free_lock)\n"
+            "print('sentinel-ok')\n"
+        )
+        env = dict(os.environ, RT_DEBUG_LOCKS="1", JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "sentinel-ok" in out.stdout

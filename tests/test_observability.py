@@ -444,3 +444,25 @@ def test_device_trace_produces_profile(tmp_path):
 
     traces = g.glob(f"{logdir}/**/plugins/profile/**/*", recursive=True)
     assert traces, f"no profile output under {logdir}"
+
+
+def test_cluster_down_cli(rt):
+    """`ray_tpu down` routes shutdown_cluster over the control plane: the
+    head must actually tear itself down (the CLI wiring for the formerly
+    orphaned h_shutdown_cluster handler — rtlint RT003)."""
+    import socket
+    import time
+
+    out = _cli("down")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "shutdown requested" in out.stdout
+    host, port = os.environ["RT_ADDRESS"].rsplit(":", 1)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection((host, int(port)), timeout=1).close()
+            time.sleep(0.2)  # head still accepting: not down yet
+        except OSError:
+            break  # control-plane port closed: the head is gone
+    else:
+        raise AssertionError("head still accepting connections after down")

@@ -1,0 +1,395 @@
+"""rtlint: framework-aware static analysis (ray_tpu/devtools/).
+
+Reference analog: the protections Ray gets from protobuf schemas + C++
+sanitizer CI, rebuilt as AST rules for a pure-Python control plane.  Each
+rule gets a synthetic positive + negative; the self-check gate at the
+bottom runs the whole suite over the real package and fails on any
+unallowlisted finding — that test IS the CI gate every PR inherits.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from ray_tpu.devtools import rules_api, rules_async, rules_metrics, \
+    rules_rpc, rules_threads
+from ray_tpu.devtools.rtlint import (Project, default_allowlist,
+                                     default_package_root, load_allowlist,
+                                     run_lint)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def make_pkg(tmp_path: Path, files: dict) -> Path:
+    root = tmp_path / "pkg"
+    for rel, source in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(source))
+    return root
+
+
+def findings(root: Path, rule) -> list:
+    return rule(Project(root))
+
+
+# -- RT001: blocking calls in async defs --------------------------------------
+
+
+class TestRT001:
+    def test_flags_blocking_calls(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/head.py": """
+            import shutil
+            import subprocess
+            import time
+
+
+            async def h_x(conn, body):
+                time.sleep(1)
+                subprocess.run(["ls"])
+                shutil.rmtree("/tmp/x")
+                with open("/tmp/f") as f:
+                    data = f.read()
+                return data
+        """})
+        got = findings(root, rules_async.check_rt001)
+        assert len(got) == 5
+        assert all(f.rule == "RT001" for f in got)
+        assert any("time.sleep" in f.message for f in got)
+        assert any("subprocess.run" in f.message for f in got)
+        assert any("open()" in f.message for f in got)
+
+    def test_flags_sync_rpc_and_socket_methods(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/head.py": """
+            async def h_x(self, conn, body):
+                reply = self.rpc.call("ping", {})
+                n = sock.recv_into(buf)
+                return reply, n
+        """})
+        msgs = [f.message for f in findings(root, rules_async.check_rt001)]
+        assert len(msgs) == 2
+        assert any("synchronous RPC" in m for m in msgs)
+        assert any(".recv_into()" in m for m in msgs)
+
+    def test_clean_async_and_sync_not_flagged(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/head.py": """
+            import asyncio
+            import time
+
+
+            def sync_helper():
+                time.sleep(1)  # sync context: fine
+
+
+            async def h_x(conn, body):
+                await asyncio.sleep(1)           # async form: fine
+                data = await reader.read(100)    # awaited read: fine
+
+                def off_loop():
+                    time.sleep(1)  # runs in an executor: fine
+
+                await asyncio.get_running_loop().run_in_executor(
+                    None, off_loop)
+                return data
+        """})
+        assert findings(root, rules_async.check_rt001) == []
+
+
+# -- RT002: lock held across await --------------------------------------------
+
+
+class TestRT002:
+    def test_flags_await_under_lock(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/head.py": """
+            async def h_x(self, conn, body):
+                with self._zygote_mutex:
+                    await self.conn.push("x", {})
+        """})
+        got = findings(root, rules_async.check_rt002)
+        assert len(got) == 1
+        assert got[0].rule == "RT002"
+        assert "_zygote_mutex" in got[0].message
+
+    def test_lock_released_before_await_ok(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/head.py": """
+            async def h_x(self, conn, body):
+                with self._lock:
+                    val = self.state
+                await self.conn.push("x", {"v": val})
+                with self._lock:  # no await inside: fine
+                    self.state = None
+        """})
+        assert findings(root, rules_async.check_rt002) == []
+
+
+# -- RT003: RPC drift ----------------------------------------------------------
+
+
+_RPC_BASE = {
+    "core/schema.py": """
+        REQUIRED = {
+            "kv_put": (("key", str),),
+            "pull_object": (("object_id", bytes),),
+        }
+    """,
+    "core/node_main.py": """
+        async def h_pull_object(conn, body):
+            return {}
+    """,
+}
+
+
+class TestRT003:
+    def test_clean_surface(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            **_RPC_BASE,
+            "core/client.py": """
+                IDEMPOTENT_METHODS = frozenset({"kv_get"})
+
+
+                class Client:
+                    def f(self):
+                        self.rpc.call("kv_put", {"key": "a"})
+                        self.rpc.call("kv_get", {"key": "a"})
+                        self.rpc.call_async("pull_object", {})
+            """,
+            "core/head.py": """
+                async def h_kv_put(self, conn, body):
+                    return {}
+
+
+                async def h_kv_get(self, conn, body):
+                    return {}
+            """,
+        })
+        assert findings(root, rules_rpc.check_rt003) == []
+
+    def test_all_four_drift_legs(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            **_RPC_BASE,
+            "core/client.py": """
+                IDEMPOTENT_METHODS = frozenset()
+
+
+                class Client:
+                    def f(self):
+                        self.rpc.call("missing_handler", {})
+                        self.rpc.call("no_schema_row", {})
+                        self.rpc.call_async("pull_object", {})
+            """,
+            "core/head.py": """
+                async def h_no_schema_row(self, conn, body):
+                    return {}
+
+
+                async def h_kv_put(self, conn, body):
+                    return {}
+
+
+                async def h_orphan(self, conn, body):
+                    return {}
+            """,
+            "core/schema.py": """
+                REQUIRED = {
+                    "kv_put": (("key", str),),
+                    "pull_object": (("object_id", bytes),),
+                    "row_without_handler": (("x", str),),
+                }
+            """,
+        })
+        msgs = "\n".join(
+            f.message for f in findings(root, rules_rpc.check_rt003))
+        assert "no h_missing_handler handler" in msgs
+        assert "'no_schema_row' has no schema.REQUIRED row" in msgs
+        assert "'row_without_handler' has no h_row_without_handler" in msgs
+        assert "h_orphan has no call site" in msgs
+        # pull_object is called (call_async) and has a schema row: clean.
+        assert "h_pull_object has no call site" not in msgs
+        assert "'pull_object' has no schema.REQUIRED row" not in msgs
+
+
+# -- RT004: remote-function footguns ------------------------------------------
+
+
+class TestRT004:
+    def test_nested_get_and_closure_capture(self, tmp_path):
+        root = make_pkg(tmp_path, {"data/pipeline.py": """
+            import ray_tpu
+
+
+            @ray_tpu.remote
+            def stage(refs):
+                return ray_tpu.get(refs)
+
+
+            def build(big_array):
+                @ray_tpu.remote
+                def worker():
+                    return big_array.sum()
+                return worker
+        """})
+        got = findings(root, rules_api.check_rt004)
+        msgs = "\n".join(f.message for f in got)
+        assert "ray_tpu.get() inside remote 'stage'" in msgs
+        assert "captures enclosing-scope variable(s) ['big_array']" in msgs
+
+    def test_clean_remote_fn(self, tmp_path):
+        root = make_pkg(tmp_path, {"data/pipeline.py": """
+            import ray_tpu
+
+            SCALE = 2  # module-level: shipped once with the function
+
+
+            @ray_tpu.remote
+            def stage(parts):  # refs resolve automatically as args
+                return [p * SCALE for p in parts]
+        """})
+        assert findings(root, rules_api.check_rt004) == []
+
+
+# -- RT005: undaemonized threads ----------------------------------------------
+
+
+class TestRT005:
+    def test_flags_leaky_thread(self, tmp_path):
+        root = make_pkg(tmp_path, {"util/bg.py": """
+            import threading
+
+
+            def start():
+                threading.Thread(target=print).start()
+        """})
+        got = findings(root, rules_threads.check_rt005)
+        assert len(got) == 1 and got[0].rule == "RT005"
+
+    def test_daemon_and_join_paths_ok(self, tmp_path):
+        root = make_pkg(tmp_path, {"util/bg.py": """
+            import threading
+
+
+            class Runner:
+                def start(self):
+                    self._t = threading.Thread(target=print, daemon=True)
+                    self._t.start()
+                    # aliased join path (the checkpoint-writer pattern)
+                    self._pending = threading.Thread(target=print)
+                    self._pending.start()
+
+                def wait(self):
+                    t = self._pending
+                    t.join()
+        """})
+        assert findings(root, rules_threads.check_rt005) == []
+
+
+# -- RT006: metric-name drift --------------------------------------------------
+
+
+_METRICS_MOD = """
+    BUILTIN_METRICS = {
+        "ray_tpu_good_total": "counter",
+        "ray_tpu_stale_rows": "gauge",
+    }
+"""
+
+
+class TestRT006:
+    def test_drift_cases(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "util/metrics.py": _METRICS_MOD,
+            "serve/app.py": """
+                from ray_tpu.util.metrics import get_counter, get_gauge
+
+                get_counter("ray_tpu_good_total", "ok")
+                get_counter("ray_tpu_unregistered_total", "missing row")
+                get_gauge("ray_tpu_good_total", "kind clash")
+            """,
+        })
+        msgs = "\n".join(
+            f.message for f in findings(root, rules_metrics.check_rt006))
+        assert "'ray_tpu_unregistered_total' is not in" in msgs
+        assert "one name must stick to one kind" in msgs
+        assert "'ray_tpu_stale_rows' is emitted nowhere" in msgs
+
+    def test_clean(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "util/metrics.py": """
+                BUILTIN_METRICS = {"ray_tpu_good_total": "counter"}
+            """,
+            "serve/app.py": """
+                from ray_tpu.util.metrics import get_counter
+
+                get_counter("ray_tpu_good_total", "ok")
+            """,
+        })
+        assert findings(root, rules_metrics.check_rt006) == []
+
+
+# -- allowlist -----------------------------------------------------------------
+
+
+class TestAllowlist:
+    def test_suppression_and_stale_detection(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/head.py": """
+            import time
+
+
+            async def h_x(conn, body):
+                time.sleep(1)
+        """})
+        allow = tmp_path / "allow.txt"
+        allow.write_text(
+            "RT001 pkg/core/head.py  # vetted for this test\n"
+            "RT002 pkg/core/gone.py  # stale entry\n"
+        )
+        kept, suppressed = run_lint(root, allow)
+        assert len(suppressed) == 1
+        assert [f.rule for f in kept] == ["ALLOWLIST"]
+        assert "stale entry" in kept[0].message
+
+    def test_reason_is_mandatory(self, tmp_path):
+        allow = tmp_path / "allow.txt"
+        allow.write_text("RT001 pkg/core/head.py\n")
+        entries, problems = load_allowlist(allow)
+        assert entries == []
+        assert len(problems) == 1
+        assert "no '# reason'" in problems[0].message
+
+
+# -- the gate: the real package must lint clean --------------------------------
+
+
+class TestPackageGate:
+    def test_package_lint_clean(self):
+        """The self-check every future PR inherits: rtlint over the live
+        package with the repo allowlist must report nothing."""
+        root = default_package_root()
+        kept, _ = run_lint(root, default_allowlist(root))
+        assert kept == [], "unallowlisted rtlint findings:\n" + "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in kept
+        )
+
+    def test_cli_exit_codes(self, tmp_path):
+        """`python -m ray_tpu lint` is the operator surface: 0 on the
+        clean tree, non-zero once a violation is seeded."""
+        clean = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "lint"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+
+        seeded = make_pkg(tmp_path, {"core/head.py": """
+            import time
+
+
+            async def h_x(conn, body):
+                time.sleep(1)
+        """})
+        bad = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "lint",
+             "--root", str(seeded), "--allowlist", str(tmp_path / "none")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert bad.returncode == 1, bad.stdout + bad.stderr
+        assert "RT001" in bad.stdout

@@ -40,6 +40,31 @@ class TestValidateUnit:
             schema.check_protocol(schema.PROTOCOL_VERSION + 1)
 
 
+class TestThreeWayDrift:
+    """Client call strings, ``h_*`` handlers, and ``schema.REQUIRED`` rows
+    are one surface with three legs (the reference keeps them fused in one
+    .proto file; here rtlint RT003 reconciles them).  Fails closed on any
+    future rename that touches fewer than all three."""
+
+    def test_no_rpc_drift(self):
+        from ray_tpu.devtools.rtlint import Project, default_package_root
+        from ray_tpu.devtools.rules_rpc import check_rt003
+
+        found = check_rt003(Project(default_package_root()))
+        assert found == [], "RPC surface drift:\n" + "\n".join(
+            f"{f.path}:{f.line}: {f.message}" for f in found
+        )
+
+    def test_every_mutating_client_method_validates(self):
+        """Spot-check the boundary actually rejects a malformed body for
+        rows added by the drift reconciliation (not just that rows exist)."""
+        with pytest.raises(schema.SchemaError, match="missing required"):
+            schema.validate("next_stream_item", {"task_id": b"x"})
+        with pytest.raises(schema.SchemaError, match="must be"):
+            schema.validate("object_free_ack", {"token": "not-a-number"})
+        schema.validate("pull_object", {"object_id": b"\x01" * 16})
+
+
 class TestBoundary:
     def test_malformed_rpc_rejected_cleanly(self, rt_shared):
         from ray_tpu.core.context import ctx
@@ -49,6 +74,12 @@ class TestBoundary:
 
         with pytest.raises(RpcError, match="must be"):
             ctx.client.call("list_state", {"kind": 42})
+
+        # pull_object validates inside its handler (pull servers register
+        # outside the head's _validated wrapper) — the row must be live at
+        # the boundary, not just present in REQUIRED.
+        with pytest.raises(RpcError, match="missing required field"):
+            ctx.client.call("pull_object", {})
 
         # The cluster stays healthy after rejecting garbage.
         ctx.client.kv_put("x", b"1")

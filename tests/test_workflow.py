@@ -90,7 +90,7 @@ def test_workflow_parallel_branches(rt, tmp_path):
     def slow_shard(i):
         import time
 
-        time.sleep(0.8)
+        time.sleep(1.5)
         return i
 
     def merge(*parts):
@@ -102,7 +102,12 @@ def test_workflow_parallel_branches(rt, tmp_path):
     out = workflow.run(node, workflow_id="par", storage=str(tmp_path))
     wall = _t.time() - t0
     assert out == 6
-    assert wall < 3.0, f"branches serialized: {wall:.1f}s for 4x0.8s steps"
+    # Bound = the 6.0s sleep-sum floor: a serialized run can NEVER beat it
+    # (the four 1.5s sleeps alone total 6.0s before any overhead), while a
+    # parallel run needs one 1.5s sleep plus overhead — ~2.4s observed
+    # under full-suite load, a ~3.6s margin (the earlier 0.8s-sleep/3.0s
+    # bound flaked under load with only tens of ms to spare).
+    assert wall < 6.0, f"branches serialized: {wall:.1f}s for 4x1.5s steps"
 
 
 def test_dynamic_workflow_fans_out_children(rt, tmp_path):
