@@ -104,16 +104,55 @@ def timeit(name, fn, multiplier=1, min_time=1.0, warmup=1):
     return rate
 
 
-def record(name, value, unit):
+def record(name, value, unit, **extra):
     base = BASELINE.get(name)
     entry = {
         "metric": name,
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(value / base, 3) if base else None,
+        **extra,
     }
     RESULTS.append(entry)
     print(json.dumps(entry), flush=True)
+
+
+def head_dispatch_count() -> float:
+    """Head-side task-dispatch counter (the decentralization probe: direct
+    actor calls and leased submissions must leave it flat)."""
+    from ray_tpu.core.context import ctx
+
+    try:
+        rows = ctx.client.call("list_state", {"kind": "metrics"})["items"]
+        for r in rows:
+            if r["name"] == "ray_tpu_scheduler_tasks_dispatched_total":
+                return float(r["value"])
+    except Exception:
+        pass
+    return 0.0
+
+
+def timeit_dataplane(name, fn, multiplier=1, min_time=1.0, warmup=1):
+    """timeit + a ``head_rpcs_per_call`` column: head dispatch-counter
+    delta over the timed window divided by operations — ~0 when the
+    dataplane carries the traffic, ~1 when every call transits the head."""
+    settle()
+    for _ in range(warmup):
+        fn()
+    reps = 0
+    d0 = head_dispatch_count()
+    start = time.perf_counter()
+    while True:
+        fn()
+        reps += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= min_time:
+            break
+    d1 = head_dispatch_count()
+    rate = reps * multiplier / elapsed
+    record(name, rate, "ops/s",
+           head_rpcs_per_call=round((d1 - d0) / (reps * multiplier), 4))
+    return rate
 
 
 def bench_single_node(quick: bool):
@@ -171,18 +210,19 @@ def bench_single_node(quick: bool):
     # -- tasks
     timeit("single_client_tasks_sync",
            lambda: ray_tpu.get(nop.remote()), min_time=mt)
-    timeit("single_client_tasks_async",
-           lambda: ray_tpu.get([nop.remote() for _ in range(100)]),
-           multiplier=100, min_time=mt)
+    timeit_dataplane("single_client_tasks_async",
+                     lambda: ray_tpu.get([nop.remote() for _ in range(100)]),
+                     multiplier=100, min_time=mt)
 
     # -- actors
     a = Srv.remote()
     ray_tpu.get(a.ping.remote())
     timeit("actor_calls_sync_1_1", lambda: ray_tpu.get(a.ping.remote()),
            min_time=mt)
-    timeit("actor_calls_async_1_1",
-           lambda: ray_tpu.get([a.ping.remote() for _ in range(100)]),
-           multiplier=100, min_time=mt)
+    timeit_dataplane("actor_calls_async_1_1",
+                     lambda: ray_tpu.get([a.ping.remote()
+                                          for _ in range(100)]),
+                     multiplier=100, min_time=mt)
 
     servers = [Srv.remote() for _ in range(4)]
     ray_tpu.get([s.ping.remote() for s in servers])
@@ -193,7 +233,8 @@ def bench_single_node(quick: bool):
             refs.extend(s.ping.remote() for _ in range(50))
         ray_tpu.get(refs)
 
-    timeit("actor_calls_async_n_n", n_n, multiplier=200, min_time=mt)
+    timeit_dataplane("actor_calls_async_n_n", n_n, multiplier=200,
+                     min_time=mt)
 
     # -- actor creation rate (reference: many_actors.json measures
     # creation at scale).  Creation only is timed; the kill churn and its
@@ -323,7 +364,19 @@ del refs
 def nop():
     return b"ok"
 
-ray_tpu.get(nop.remote(), timeout=120)  # warm a worker lease
+ray_tpu.get(nop.remote(), timeout=120)  # warm a worker
+# Warm the task lease: keep submitting until this client holds a live
+# direct slot (or times out into the head path) so the barrier-aligned
+# window measures steady-state submission, not lease acquisition.
+dp = ctx.client._dataplane
+deadline = time.monotonic() + 6
+while dp is not None and time.monotonic() < deadline:
+    ray_tpu.get([nop.remote() for _ in range(4)], timeout=120)
+    with dp._lock:
+        if any(not s.dead and not s.revoked
+               for p in dp._pools.values() for s in p.slots):
+            break
+    time.sleep(0.25)
 barrier("tasks")
 t0 = time.perf_counter()
 task_refs = [nop.remote() for _ in range(task_reps)]
@@ -348,6 +401,7 @@ def bench_multi_client(quick: bool):
     put_reps = 16 if quick else 64       # 1 MiB puts per client
     task_reps = 128 if quick else 512
     env = dict(os.environ)  # RT_ADDRESS points at the live head
+    d0 = head_dispatch_count()
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _MULTI_CLIENT_SCRIPT, str(i),
@@ -378,8 +432,14 @@ def bench_multi_client(quick: bool):
     if len(rows) == nclients:
         record("multi_client_put_gib",
                sum(r["put_gib"] for r in rows), "GiB/s")
+        # Dispatch-counter delta spans the whole section (incl. each
+        # client's warmup call), so ~0 still reads "the task traffic never
+        # transited the head".
+        d1 = head_dispatch_count()
         record("multi_client_tasks_async",
-               sum(r["tasks_async"] for r in rows), "tasks/s")
+               sum(r["tasks_async"] for r in rows), "tasks/s",
+               head_rpcs_per_call=round(
+                   (d1 - d0) / (nclients * task_reps), 4))
     else:
         print(f"# multi-client section incomplete: {len(rows)}/{nclients}",
               file=sys.stderr)

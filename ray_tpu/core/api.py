@@ -307,10 +307,9 @@ def wait(
 
 def cancel(ref: ObjectRef, *, force: bool = False):
     _ensure_init()
-    ctx.client.call(
-        "cancel_task",
-        {"task_id": ref.task_id().binary(), "force": force},
-    )
+    # Routed: direct-plane tasks cancel over the peer connection, head
+    # tasks via the control plane.
+    ctx.client.cancel_task(ref.task_id().binary(), force)
 
 
 def kill(actor: "ActorHandle", *, no_restart: bool = True):
@@ -682,10 +681,11 @@ class RemoteFunction:
             "runtime_env": self._renv(),
         }
         _inject_trace(spec)
-        # Submission is pipelined AND batched: the ref returns immediately
-        # and bursts coalesce into one head RPC (reference: task submission
-        # is async; errors surface on ray.get of the returned ref).
-        ctx.client.call_batched("submit_task", spec)
+        # Submission is pipelined AND batched — and, when a task lease is
+        # held, routed straight to a leased worker's peer server with no
+        # head traffic at all (reference: task submission is async; errors
+        # surface on ray.get of the returned ref).
+        ctx.client.submit_task(spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(r) for r in return_ids]
@@ -772,7 +772,10 @@ class ActorHandle:
             # actor.py ActorMethod.options(concurrency_group=...)).
             spec["concurrency_group"] = options["concurrency_group"]
         _inject_trace(spec)
-        ctx.client.call_batched("submit_actor_task", spec)
+        # Peer-direct once the actor's address is resolved (the head sees
+        # only liveness/telemetry, not per-call traffic); head-mediated
+        # before that and on any peer-plane failure.
+        ctx.client.submit_actor_task(spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(r) for r in return_ids]
@@ -896,7 +899,14 @@ class ActorClass:
             "lifetime": o.get("lifetime"),
             "creation_task": creation_task,
         }
+        # Constructor args may be locally-cached direct-call results: the
+        # head must know them before it dep-tracks the creation task.
+        ctx.client.ensure_args_shared(creation_task)
         ctx.client.call("create_actor", spec)
+        # Pre-warm the direct route: the ALIVE broadcast carries the
+        # hosting worker's peer address and the client dials during
+        # creation dispatch, not on the first call.
+        ctx.client.prepare_actor_route(actor_id.binary())
         return ActorHandle(
             actor_id, method_names, spec["max_task_retries"], self.__name__,
             method_defaults,

@@ -31,6 +31,7 @@ IDEMPOTENT_METHODS = frozenset({
     "list_state", "kv_get", "kv_keys", "cluster_resources",
     "available_resources", "store_stats", "object_sizes", "ping",
     "get_actor_by_name", "list_named_actors", "health_ack", "get_log",
+    "resolve_actor",
 })
 #: attempts / base delay for the jittered exponential backoff below.
 IDEMPOTENT_RETRY_ATTEMPTS = 3
@@ -47,6 +48,7 @@ class Client:
         pid: int = 0,
         session: Optional[str] = None,
         log_path: Optional[str] = None,
+        peer_addr: Optional[str] = None,
     ):
         from . import schema as wire_schema
 
@@ -57,6 +59,10 @@ class Client:
             "kind": kind, "pid": pid,
             "protocol": wire_schema.PROTOCOL_VERSION,
         }
+        if peer_addr:
+            # Worker-plane endpoint: the head hands this address to peers
+            # for direct actor calls and task leases.
+            body["peer_addr"] = peer_addr
         if log_path:
             # Registered in the head's cluster log index (retained past
             # process death) so `get_log` can serve this process's output.
@@ -126,6 +132,15 @@ class Client:
         self._pull_lock = make_lock("client.pull_conns")
         self.rpc.on_push("pubsub", self._on_pubsub)
         self.rpc.on_push("object_free", self._on_object_free)
+        # Peer dataplane: direct actor calls + leased task slots (proxy
+        # drivers excluded — no peer reachability guarantees off-host).
+        self._dataplane = None
+        cfg = get_config()
+        if not self.proxy and kind in ("driver", "worker") \
+                and (cfg.direct_calls or cfg.task_leases):
+            from .dataplane import Dataplane
+
+            self._dataplane = Dataplane(self)
         # Free-queue flusher: ObjectRef.__del__ only appends + signals (it
         # may run from cyclic GC inside a client critical section, so it
         # must never take client locks itself); this thread does the RPCs.
@@ -152,6 +167,10 @@ class Client:
                 # that stops making client calls (e.g. waits on side effects).
                 self._flush_submit_batch()
                 self._flush_put_batch()
+                if self._dataplane is not None:
+                    # Lease renew/idle-return, stale-queue flush, retired
+                    # connection teardown.
+                    self._dataplane.maintain()
             except Exception:
                 pass
 
@@ -166,6 +185,8 @@ class Client:
 
     def _on_object_free(self, body):
         dirty: List[bytes] = []
+        if self._dataplane is not None:
+            self._dataplane.drop_results(list(body.get("object_ids", [])))
         for raw in body.get("object_ids", []):
             oid = ObjectID(raw)
             self._local_drop(oid)
@@ -288,6 +309,56 @@ class Client:
         if exc is not None:
             raise exc
 
+    # -- task/actor submission (dataplane routing) -----------------------------
+
+    def submit_task(self, spec: dict) -> None:
+        """Submit a stateless task: a leased direct slot when one is held
+        (peer plane, no head traffic), else the head path — which also
+        primes lease acquisition for the next burst."""
+        dp = self._dataplane
+        if dp is not None:
+            dp.ensure_args_shared(spec)
+            if dp.submit_task(spec):
+                return
+        self.call_batched("submit_task", spec)
+
+    def submit_actor_task(self, spec: dict) -> None:
+        """Submit an actor call: peer-direct once the actor's address is
+        resolved (and the switch is order-safe), else head-mediated."""
+        dp = self._dataplane
+        if dp is not None:
+            dp.ensure_args_shared(spec)
+            if dp.submit_actor_task(spec):
+                return
+            dp.note_head_actor_call(spec["actor_id"])
+        self.call_batched("submit_actor_task", spec)
+
+    def prepare_actor_route(self, raw_actor_id: bytes) -> None:
+        """Register interest in an actor's peer route at creation time (the
+        ALIVE broadcast then pre-dials during creation dispatch)."""
+        if self._dataplane is not None:
+            self._dataplane.prepare_actor_route(raw_actor_id)
+
+    def ensure_shared(self, raw: bytes) -> None:
+        """A ref is crossing a process boundary: make sure the head can
+        answer for it even if its value only lives in this process's
+        direct-result cache."""
+        if self._dataplane is not None:
+            self._dataplane.ensure_shared(raw)
+
+    def ensure_args_shared(self, spec: dict) -> None:
+        """Same, for every arg id of a spec that bypasses the routed
+        submission paths (e.g. actor creation tasks)."""
+        if self._dataplane is not None:
+            self._dataplane.ensure_args_shared(spec)
+
+    def cancel_task(self, task_raw: bytes, force: bool = False):
+        if self._dataplane is not None \
+                and self._dataplane.cancel_task(task_raw, force):
+            return {"cancelled": True}
+        return self.call("cancel_task",
+                         {"task_id": task_raw, "force": force})
+
     def drain_bg(self, timeout: float = 30.0):
         """Block until all fired background RPCs have been acknowledged."""
         self._flush_put_batch()
@@ -402,11 +473,28 @@ class Client:
     def get(self, refs: Sequence, timeout: float = -1.0) -> List[Any]:
         self.check_bg()
         object_ids = [r.object_id for r in refs]
+        dp = self._dataplane
+        if dp is not None:
+            # Flush staged peer submissions, then block on their replies —
+            # no head involvement for the whole get when every ref is a
+            # direct result.  The direct wait consumes from the SAME
+            # timeout budget the head fetch below gets (never double it).
+            t0 = time.monotonic()
+            dp.flush_pending()
+            dp.await_calls([o.binary() for o in object_ids], timeout)
+            if timeout >= 0:
+                timeout = max(0.0, timeout - (time.monotonic() - t0))
         # In-process store first: objects this process put or already read
         # resolve without a control-plane round trip.
         local: Dict[int, bytes] = {}
+        direct: Dict[int, dict] = {}
         missing: List[ObjectID] = []
         for i, oid in enumerate(object_ids):
+            if dp is not None:
+                d = dp.result_desc(oid.binary())
+                if d is not None:
+                    direct[i] = d
+                    continue
             blob = self._local_get(oid)
             if blob is not None:
                 local[i] = blob
@@ -415,6 +503,14 @@ class Client:
         descs = iter(self.get_raw(missing, timeout) if missing else ())
         out = []
         for i, oid in enumerate(object_ids):
+            if i in direct:
+                try:
+                    out.append(self._materialize(oid, direct[i]))
+                except exceptions.ObjectReconstructionFailedError:
+                    raise
+                except exceptions.ObjectLostError:
+                    out.append(self._recover_and_get(oid, timeout))
+                continue
             if i in local:
                 out.append(serialization.unpack(local[i]))
                 continue
@@ -676,20 +772,69 @@ class Client:
     def wait(self, refs: Sequence, num_returns: int, timeout: float):
         self._flush_put_batch()
         self._flush_submit_batch()
+        raws = [r.object_id.binary() for r in refs]
+        dp = self._dataplane
+        if dp is not None:
+            dp.flush_pending()
+        if dp is None:
+            ready_set = self._wait_head(raws, num_returns, timeout)
+        else:
+            # Mixed readiness sources: direct-call results resolve locally
+            # (their completion never touches the head), everything else
+            # via the head's wait.  Pure-direct waits make no head RPC at
+            # all; mixed waits slice the head wait so local completions
+            # can satisfy num_returns early.
+            deadline = None if timeout < 0 else time.monotonic() + timeout
+            head_ready: set = set()
+            while True:
+                local_ready, events, head_raws = dp.wait_split(raws)
+                ready_set = local_ready | head_ready
+                if len(ready_set) >= num_returns:
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                head_pending = [raw for raw in head_raws
+                                if raw not in head_ready]
+                if head_pending:
+                    slice_t = 0.05 if events else remaining
+                    if remaining is not None and slice_t is not None:
+                        slice_t = min(slice_t, remaining)
+                    head_ready |= self._wait_head(
+                        head_pending,
+                        min(max(num_returns - len(ready_set), 1),
+                            len(head_pending)),
+                        -1.0 if slice_t is None else slice_t,
+                    )
+                    if not events:
+                        # The head wait consumed the whole budget: final.
+                        local_ready, _, _ = dp.wait_split(raws)
+                        ready_set = local_ready | head_ready
+                        break
+                elif events:
+                    step = (0.02 if remaining is None
+                            else max(0.001, min(0.02, remaining)))
+                    events[0].wait(step)
+                else:
+                    break
+        ready = [r for r in refs if r.object_id.binary() in ready_set]
+        not_ready = [r for r in refs if r.object_id.binary() not in ready_set]
+        return ready, not_ready
+
+    def _wait_head(self, raws: List[bytes], num_returns: int,
+                   timeout: float) -> set:
         with self._maybe_blocked():
             reply = self.rpc.call(
                 "wait_objects",
                 {
-                    "object_ids": [r.object_id.binary() for r in refs],
+                    "object_ids": raws,
                     "num_returns": num_returns,
                     "timeout": timeout,
                 },
                 timeout=1e9 if timeout < 0 else timeout + 30,
             )
-        ready_set = set(reply["ready"])
-        ready = [r for r in refs if r.object_id.binary() in ready_set]
-        not_ready = [r for r in refs if r.object_id.binary() not in ready_set]
-        return ready, not_ready
+        return set(reply["ready"])
 
     def free_objects(self, raw_ids: List[bytes]):
         for raw in raw_ids:
@@ -697,12 +842,32 @@ class Client:
             if raw in self.large_oids:
                 self._last_large_free = time.monotonic()
             self.large_oids.discard(raw)
+        if self._dataplane is not None:
+            # Drop cached direct results; defer frees of args pinned by
+            # in-flight direct calls (released at call completion).
+            raw_ids = self._dataplane.intercept_frees(raw_ids)
+            if not raw_ids:
+                return
         # Flush buffered registrations/submissions first: freeing an object
         # whose registration is still batched would hit an unknown record
         # head-side and the late registration would resurrect it as a leak.
         self._flush_put_batch()
         self._flush_submit_batch()
         self.rpc.call("free_objects", {"object_ids": raw_ids})
+
+    def free_objects_bg(self, raw_ids: List[bytes]):
+        """Pipelined free for the ObjectRef GC flusher: local drops +
+        dataplane interception, then a fire-and-forget head RPC."""
+        for raw in raw_ids:
+            self._local_drop(ObjectID(raw))
+            if raw in self.large_oids:
+                self._last_large_free = time.monotonic()
+            self.large_oids.discard(raw)
+        if self._dataplane is not None:
+            raw_ids = self._dataplane.intercept_frees(raw_ids)
+            if not raw_ids:
+                return
+        self.call_bg("free_objects", {"object_ids": raw_ids})
 
     def add_reference(self, raw_id: bytes):
         try:
@@ -711,6 +876,12 @@ class Client:
             pass
 
     def next_stream_item(self, task_id: bytes, index: int) -> dict:
+        if self._dataplane is not None:
+            # Direct streaming tasks serve their items straight from the
+            # executing worker (peer_next_stream_item).
+            reply = self._dataplane.next_stream_item(task_id, index)
+            if reply is not None:
+                return reply
         with self._maybe_blocked():
             return self.rpc.call(
                 "next_stream_item", {"task_id": task_id, "index": index},
@@ -771,6 +942,14 @@ class Client:
         self.check_bg()
         self._flush_put_batch()
         self._flush_submit_batch()
+        # getattr: synthetic/partial clients (tests, tooling) may lack the
+        # dataplane field entirely.
+        dp = getattr(self, "_dataplane", None)
+        if dp is not None:
+            # Cross-plane ordering: staged peer submissions flush before
+            # any synchronous control-plane call (kill_actor after a burst
+            # of casts must land after them, matching head-batch flushing).
+            dp.flush_pending()
         if method not in IDEMPOTENT_METHODS:
             return self.rpc.call(method, body, timeout=timeout)
         # Idempotent reads survive transient connection hiccups (head busy,
@@ -900,6 +1079,14 @@ class Client:
             self.drain_bg(timeout=5.0)
         except BaseException:  # noqa: BLE001 — shutdown is best-effort
             pass
+        if self._dataplane is not None:
+            try:
+                # Return held leases + close peer connections before the
+                # head connection drops (disconnect would release them
+                # anyway; this keeps shutdown deterministic).
+                self._dataplane.close()
+            except BaseException:  # noqa: BLE001
+                pass
         for st in self._stores.values():
             st.close()
         self.rpc.close()

@@ -95,6 +95,39 @@ class Config:
     # -- RPC ------------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 * 1024 * 1024
+    # -- dataplane (peer-to-peer calls + node-local task leases) --------------
+    # Direct actor calls: after a head-mediated address resolution the
+    # driver dials the owning worker's peer RPC server and submits actor
+    # tasks peer-to-peer — the head sees liveness, restart events, and
+    # batched telemetry, never per-call traffic (reference: core workers
+    # submit actor tasks directly to each other, core_worker.proto
+    # PushTask).  RT_DIRECT_CALLS=0 force-disables (every call falls back
+    # to the head-mediated path).
+    direct_calls: bool = True
+    # Node-local task leasing: drivers lease execution slots (idle workers)
+    # per resource shape from the head and submit stateless tasks straight
+    # to the leased workers' peer servers (reference: raylet worker leasing,
+    # node_manager.proto RequestWorkerLease).  RT_TASK_LEASES=0 disables.
+    task_leases: bool = True
+    # Leases are bounded: count per (client, shape) ...
+    lease_max_slots: int = 8
+    # ... and TTL (seconds).  Clients renew active leases in the background;
+    # the head revokes unrenewed ones so a wedged client can't hold
+    # capacity forever.
+    lease_ttl_s: float = 10.0
+    # Client-side: return a lease that served no task for this long, so
+    # idle-held slots (and their reserved resources) flow back to the
+    # cluster promptly.
+    lease_idle_return_s: float = 2.0
+    # Per-slot pipelining window: specs in flight on one leased worker
+    # before the client queues locally.  Deep enough that a whole burst
+    # ships in one coalesced flush (a shallow window dribbles the tail out
+    # one send per completion, paying a loop wakeup each); bounded so a
+    # runaway submit loop can't grow worker queues without limit.
+    direct_inflight_per_slot: int = 256
+    # Peer dials fail fast (a dead worker's address must not stall the
+    # caller for the full control-plane connect timeout).
+    peer_connect_timeout_s: float = 2.0
     # Control-plane persistence: when set, the head snapshots its durable
     # state (KV table + named-actor specs) here and restores on startup —
     # the analog of GCS fault tolerance via Redis-backed tables

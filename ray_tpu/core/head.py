@@ -44,6 +44,12 @@ RETIRING = "retiring"
 # BLOCKED: leased worker parked in a nested get/wait; its task's resources
 # are released so the pool can run other work (see h_task_blocked).
 BLOCKED = "blocked"
+# DIRECT: worker leased out to a client for peer-to-peer task submission —
+# the client pushes specs straight to the worker's peer server and the head
+# never sees the per-call traffic (reference: raylet worker leasing +
+# core-worker direct task push).  Excluded from head dispatch until the
+# lease returns.
+DIRECT = "direct"
 PENDING, RUNNING, FINISHED, FAILED = "PENDING", "RUNNING", "FINISHED", "FAILED"
 
 
@@ -81,6 +87,9 @@ class WorkerState:
         # jax on CPU, so chip grants (which flip JAX_PLATFORMS before the
         # first jax import) only go to fresh processes.
         self.used = False
+        # Address of the worker's peer RPC server (direct actor calls and
+        # leased task submission dial this).  Registered at worker_ready.
+        self.peer_addr: str = ""
 
 
 _task_seq = 0
@@ -280,6 +289,13 @@ class Head:
         self.pg_owner_conn: "Dict[PlacementGroupID, int]" = {}
         self._pending_frees: Dict[int, dict] = {}
         self._free_token = 0
+        # Live task leases: lease_id -> {worker_id, node_id, conn_id,
+        # resources, expires, revoke_deadline}.  A lease is the head's
+        # record that a worker's execution slot (and its resources) belongs
+        # to a client for direct submission (reference: raylet
+        # LocalLeaseManager's leased-worker table).
+        self.leases: Dict[bytes, dict] = {}
+        self._last_lease_preempt = 0.0
         self.metrics_by_pid: Dict[int, list] = {}
         # Counters/histograms of departed processes (see _retire_metrics):
         # cluster totals must stay monotonic across worker churn.
@@ -345,6 +361,8 @@ class Head:
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
             "node_health_ack", "node_stats", "node_drain", "span",
             "get_log", "stack_dump", "stack_dump_reply",
+            "resolve_actor", "lease_request", "lease_return", "lease_renew",
+            "direct_done",
         ]:
             self.server.register(
                 name, _validated(name, getattr(self, f"h_{name}"))
@@ -699,6 +717,20 @@ class Head:
                         requeued = True
                 if requeued:
                     self._kick()
+                # Lease TTLs: revoke unrenewed leases; force-reclaim ones
+                # whose revoke handshake never completed (dead/wedged
+                # client) so slots always flow back to the pool.
+                for lease_id in list(self.leases):
+                    lease = self.leases.get(lease_id)
+                    if lease is None:
+                        continue
+                    deadline = lease["revoke_deadline"]
+                    if deadline is not None:
+                        if now >= deadline:
+                            self._finalize_lease(
+                                lease_id, "revoke_timeout", revoked=True)
+                    elif now >= lease["expires"]:
+                        await self._revoke_lease(lease_id, "ttl_expired")
                 await self._check_memory_pressure()
             except asyncio.CancelledError:
                 return
@@ -869,6 +901,13 @@ class Head:
             RT_HEAD_ADDR=f"{self.host}:{self.port}",
             RT_NODE_ID=node_id.hex(),
             RT_SESSION=self.node_sessions[node_id],
+            # Peer-plane wiring: the host the worker's peer RPC server
+            # binds, and the node's object-plane endpoints (stamped into
+            # direct-call result descriptors so cross-node readers can pull
+            # without a directory lookup).
+            RT_PEER_HOST=self.host,
+            RT_OBJECT_ADDR=self.node_object_addrs.get(node_id, ""),
+            RT_BULK_ADDR=self.node_bulk_addrs.get(node_id, ""),
             # Workers default to CPU so they never grab the TPU from under the
             # driver; tasks that need the chip opt in via resources={"TPU": n}
             # + runtime_env (see worker_main._maybe_enable_tpu).
@@ -926,6 +965,7 @@ class Head:
             worker_id = WorkerID(body["worker_id"])
             node_id = NodeID(body["node_id"])
             w = WorkerState(worker_id, node_id, conn, body.get("pid", 0))
+            w.peer_addr = body.get("peer_addr") or ""
             self.workers[worker_id] = w
             self.conn_to_worker[conn.conn_id] = worker_id
             conn.meta["kind"] = "worker"
@@ -1016,6 +1056,12 @@ class Head:
                 self.store.free(oid, pool=False)
             except Exception:
                 pass
+        # Leases owned by a departing client release immediately (their
+        # resources and workers return to the pool — the driver-disconnect
+        # analog of lease return).
+        for lease_id, lease in list(self.leases.items()):
+            if lease["conn_id"] == conn.conn_id:
+                self._finalize_lease(lease_id, "owner_disconnected")
         worker_id = self.conn_to_worker.pop(conn.conn_id, None)
         if conn.meta.get("pid") is not None:
             self._retire_metrics(conn.meta["pid"])
@@ -1135,7 +1181,12 @@ class Head:
                     await daemon.push("free_objects", {"object_ids": [oid.binary()]})
             return {"freed": True}
         rec = self._obj(oid)
-        if body.get("inline") is not None:
+        if body.get("error") is not None:
+            # Deferred registration of a direct-call failure result: the
+            # submitter shares the ref with another process, which must see
+            # the same exception a local get() raises.
+            rec.error = body["error"]
+        elif body.get("inline") is not None:
             rec.inline = body["inline"]
             rec.size = len(rec.inline)
         else:
@@ -1431,12 +1482,23 @@ class Head:
 
     async def h_put_object_batch(self, conn, body):
         """Registration batch for inline objects (client-side put buffering:
-        one RPC per ~64 small puts instead of one each)."""
+        one RPC per ~64 small puts instead of one each).  Entries may also
+        carry an error blob or a shm descriptor (size + node) — the
+        direct-call result registration path rides the same batch so a
+        registration can never overtake the submission that references it."""
         for entry in body["objects"]:
             oid = ObjectID(entry["object_id"])
             rec = self._obj(oid)
-            rec.inline = entry["inline"]
-            rec.size = len(rec.inline)
+            if entry.get("error") is not None:
+                rec.error = entry["error"]
+            elif entry.get("inline") is not None:
+                rec.inline = entry["inline"]
+                rec.size = len(rec.inline)
+            elif entry.get("size") is not None:
+                rec.size = entry["size"]
+                node_id = NodeID(entry["node_id"])
+                rec.locations.add(node_id)
+                self._adopt_local(oid, node_id)
             rec.sealed = True
             rec.ref_count = max(rec.ref_count, 1)
             self._notify_object_ready(oid)
@@ -2017,6 +2079,26 @@ class Head:
             # shape survives an early-exit pass.
             for t in reversed(requeue):
                 self._enqueue_task(t, front=True)
+        if self.queued_tasks and self.leases:
+            # Queued work that couldn't place while slots are leased out:
+            # preempt the stalest lease so head-scheduled shapes (gangs,
+            # TPU grants, bigger bundles) can't be starved by direct-plane
+            # reservations.  Age-gated (a momentary queue blip during a
+            # burst must not revoke a lease the burst is about to use),
+            # one per pass, with a cooldown.
+            now = time.monotonic()
+            oldest_wait = max(
+                (time.time() - t.submit_time for t in self.queued_tasks
+                 if t.state == PENDING), default=0.0)
+            if oldest_wait > 0.5 and now - self._last_lease_preempt > 0.2:
+                candidates = [
+                    (lease["expires"], lid)
+                    for lid, lease in self.leases.items()
+                    if lease["revoke_deadline"] is None
+                ]
+                if candidates:
+                    self._last_lease_preempt = now
+                    await self._revoke_lease(min(candidates)[1], "preempted")
 
     async def _drain_parked(self):
         """Dispatch node-committed tasks to workers that have become idle.
@@ -2104,7 +2186,12 @@ class Head:
                 continue
             if w.state in (STARTING, IDLE, LEASED):
                 count += 1
-            elif w.state == BLOCKED:
+            elif w.state in (BLOCKED, DIRECT):
+                # Direct-leased workers are spoken for by a client's lease,
+                # not by this pool: like blocked workers, each permits one
+                # extra spawn (else a driver leasing the whole pool would
+                # starve head-scheduled tasks of processes), bounded by the
+                # same hard cap.
                 blocked += 1
         pending = self._spawn_pending.get(node_id, 0)
         # Blocked workers each permit one extra pool slot (their task's
@@ -2282,6 +2369,7 @@ class Head:
                     self._mark_dirty()  # drop from the snapshot
                     actor.death_cause = body.get("error_repr", "creation failed")
                     await self._fail_actor_queue(actor, body.get("error"))
+                    await self._publish_actor_event(actor, "DEAD")
                     if worker:
                         worker.state = IDLE
                         worker.actor_id = None
@@ -2290,6 +2378,10 @@ class Head:
                     await self._publish(
                         f"actor:{actor_id.hex()}", {"state": "ALIVE"}
                     )
+                    # Route broadcast with the hosting worker's peer
+                    # address: creating clients pre-dial during creation
+                    # dispatch (no first-call handshake cliff).
+                    await self._publish_actor_event(actor, "ALIVE")
                     await self._drain_actor_queue(actor)
             self._release_task_resources(task, worker, keep_worker_busy=not failed)
         elif task.spec.get("actor_id"):
@@ -2434,6 +2526,12 @@ class Head:
         grace_s = float(body.get("grace_s", 0.0))
         marked = self.scheduler.mark_draining(node_id)
         self._event("node_drain", node=node_id.hex(), grace_s=grace_s)
+        # Revoke the draining node's task leases: clients stop routing new
+        # work there, in-flight specs drain inside the grace window, and
+        # the slots' resources free for the exclusion accounting.
+        for lease_id, lease in list(self.leases.items()):
+            if lease["node_id"] == node_id:
+                await self._revoke_lease(lease_id, "node_draining")
         await self._publish("node_events", {
             "event": "drain",
             "node_id": node_id.hex(),
@@ -2689,6 +2787,7 @@ class Head:
                 actor.death_cause = "killed via kill_actor"
                 if actor.name:
                     self.named_actors.pop(actor.name, None)
+                await self._publish_actor_event(actor, "DEAD")
                 await self._fail_actor_queue(actor, None)
                 self._free_actor_creation_args(actor)
         return {"killed": True}
@@ -2723,6 +2822,248 @@ class Head:
     async def h_list_named_actors(self, conn, body):
         return {"names": sorted(self.named_actors)}
 
+    # -- dataplane: direct actor calls + node-local task leases ---------------
+    # (reference: Ray's core workers submit actor tasks directly to each
+    # other and lease execution slots from the per-node raylet so
+    # steady-state submission never touches the GCS — core_worker.proto
+    # PushTask, node_manager.proto RequestWorkerLease.  The head stays the
+    # lessor and the address directory; the per-call traffic moves to the
+    # workers' peer servers.)
+
+    def _actor_route_wire(self, actor: ActorRecord) -> Optional[dict]:
+        """Peer-route descriptor for an ALIVE actor's hosting worker, or
+        None when the worker has no reachable peer server."""
+        worker = self.workers.get(actor.worker_id) if actor.worker_id else None
+        if worker is None or not worker.conn.alive or not worker.peer_addr:
+            return None
+        return {
+            "addr": worker.peer_addr,
+            "worker_id": worker.worker_id.binary(),
+            "node_id": worker.node_id.binary(),
+            "session": self.node_sessions.get(worker.node_id, self.session),
+            # Object-plane endpoints of the worker's node: direct-result
+            # descriptors stamp these so cross-node readers can pull
+            # without a directory lookup.
+            "object_addr": self.node_object_addrs.get(worker.node_id),
+            "bulk_addr": self.node_bulk_addrs.get(worker.node_id),
+        }
+
+    async def h_resolve_actor(self, conn, body):
+        """Address resolution for direct actor calls.  `busy` reports
+        whether the actor has head-queued or in-flight tasks: a client that
+        already routed calls through the head must not switch to the peer
+        plane while any could still be ahead (per-submitter FIFO has to
+        survive the switch); a client with no prior traffic to this actor
+        may dial regardless of other submitters."""
+        actor = self.actors.get(ActorID(body["actor_id"]))
+        if actor is None or actor.state == "DEAD":
+            return {"ready": False, "dead": True}
+        if (actor.spec.get("creation_task") or {}).get("execute_out_of_order"):
+            # Out-of-order dispatch is a head-side reordering feature; a
+            # FIFO peer connection cannot express it.
+            return {"ready": False, "unsupported": True}
+        if actor.state != "ALIVE":
+            return {"ready": False}
+        route = self._actor_route_wire(actor)
+        if route is None:
+            return {"ready": False}
+        worker = self.workers[actor.worker_id]
+        busy = bool(actor.pending_tasks) or bool(worker.inflight)
+        return {"ready": True, "busy": busy, **route}
+
+    async def _publish_actor_event(self, actor: ActorRecord, state: str):
+        """Actor lifecycle broadcast for client route caches: ALIVE carries
+        the peer route (pre-warm — subscribers dial during creation
+        dispatch instead of paying the handshake on the first call);
+        RESTARTING/DEAD invalidate cached addresses."""
+        data = {"actor_id": actor.actor_id.hex(), "state": state}
+        if state == "ALIVE":
+            route = self._actor_route_wire(actor)
+            if route is not None:
+                data.update(route)
+        await self._publish("actor_events", data)
+
+    async def h_direct_done(self, conn, body):
+        """Batched completion report for a directly-executed task (peer
+        actor call or leased submission): keeps the task history, the
+        timeline, and actor accounting complete without per-call head
+        dispatch.  Return-object registration rides the submitter's put
+        batch, not this report."""
+        task_id = TaskID(body["task_id"])
+        failed = bool(body.get("failed"))
+        state = FAILED if failed else FINISHED
+        cap = self.config.task_history_max_tasks
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        if cap > 0:
+            hexid = task_id.hex()
+            rec = self.task_history.get(hexid)
+            if rec is None:
+                rec = self.task_history[hexid] = {
+                    "task_id": hexid,
+                    "name": body.get("name", ""),
+                    "actor_id": (ActorID(body["actor_id"]).hex()
+                                 if body.get("actor_id") else None),
+                    "state": state,
+                    "node_id": None,
+                    "worker_id": None,
+                    "error": None,
+                    "traceback": None,
+                    "events": [],
+                }
+                while len(self.task_history) > cap:
+                    self.task_history.popitem(last=False)
+            ev: Dict[str, Any] = {"state": state,
+                                  "ts": body.get("end") or time.time(),
+                                  "direct": True}
+            if worker_id is not None:
+                rec["worker_id"] = ev["worker"] = worker_id.hex()
+                w = self.workers.get(worker_id)
+                if w is not None:
+                    rec["node_id"] = ev["node"] = w.node_id.hex()
+            if failed:
+                rec["error"] = ev["error"] = body.get("error_repr", "")
+                rec["traceback"] = (body.get("error_tb")
+                                    or body.get("error_repr", ""))
+            rec["state"] = state
+            rec["events"].append(ev)
+            if len(rec["events"]) > self.config.task_history_max_events:
+                del rec["events"][1]
+        self.finished_tasks.append({
+            "task_id": task_id.hex(),
+            "name": body.get("name", ""),
+            "state": state,
+            "start_time": body.get("start", 0.0),
+            "end_time": body.get("end", 0.0),
+            "error": body.get("error_repr") if failed else None,
+        })
+        self._event("task_done", task=task_id.hex(), failed=failed,
+                    direct=True)
+        if body.get("actor_id"):
+            actor = self.actors.get(ActorID(body["actor_id"]))
+            if actor is not None and not failed:
+                actor.num_executed += 1
+        if worker_id is not None:
+            w = self.workers.get(worker_id)
+            if w is not None:
+                w.last_seen = time.monotonic()
+        return {}
+
+    async def h_lease_request(self, conn, body):
+        """Grant direct-submission slots: idle peer-reachable workers whose
+        node can hold the shape's resources.  Never grants while the head
+        itself has unplaced work — leased-out capacity must not starve
+        queued tasks or pending gangs.  Scheduler invariants hold because a
+        slot IS a resource acquisition (scheduler.lease_slot), released at
+        return/revoke/disconnect."""
+        cfg = self.config
+        resources = {k: float(v)
+                     for k, v in (body.get("resources") or {}).items()}
+        count = max(0, min(int(body.get("count", 1)), cfg.lease_max_slots))
+        slots: List[dict] = []
+        starved = bool(self.pending_pgs) or any(
+            q for q in self.node_parked.values())
+        if not starved and self.queued_tasks:
+            # Queued head work only blocks grants once it has genuinely
+            # waited (a burst's own in-flight submissions must not deny
+            # the lease that would carry the next burst).
+            starved = max(
+                (time.time() - t.submit_time for t in self.queued_tasks
+                 if t.state == PENDING), default=0.0) > 0.25
+        if not starved and int(resources.get("TPU", 0)) < 1:
+            now = time.monotonic()
+            # Fairness: one cold client must not vacuum the whole idle pool
+            # in a single grant (multi-client warm-up would starve the
+            # rest onto the head path) — leave half the idle workers for
+            # other requesters; growth requests can take more later.
+            n_idle = sum(1 for w in self.workers.values()
+                         if w.state == IDLE and w.conn.alive and w.peer_addr)
+            count = min(count, max(1, n_idle // 2)) if n_idle else 0
+            for w in self.workers.values():
+                if len(slots) >= count:
+                    break
+                if w.state != IDLE or not w.conn.alive or not w.peer_addr:
+                    continue
+                if not self.scheduler.lease_slot(w.node_id, resources):
+                    continue
+                lease_id = os.urandom(8)
+                self.leases[lease_id] = {
+                    "worker_id": w.worker_id,
+                    "node_id": w.node_id,
+                    "conn_id": conn.conn_id,
+                    "resources": resources,
+                    "expires": now + cfg.lease_ttl_s,
+                    "revoke_deadline": None,
+                }
+                w.state = DIRECT
+                w.used = True
+                w.last_seen = now
+                slots.append({
+                    "lease_id": lease_id,
+                    "worker_id": w.worker_id.binary(),
+                    "node_id": w.node_id.binary(),
+                    "addr": w.peer_addr,
+                    "session": self.node_sessions.get(w.node_id,
+                                                      self.session),
+                    "object_addr": self.node_object_addrs.get(w.node_id),
+                    "bulk_addr": self.node_bulk_addrs.get(w.node_id),
+                })
+        return {"slots": slots, "ttl_s": cfg.lease_ttl_s}
+
+    def _finalize_lease(self, lease_id: bytes, reason: str,
+                        revoked: bool = False):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        self.scheduler.release_slot(lease["node_id"], lease["resources"])
+        w = self.workers.get(lease["worker_id"])
+        if w is not None and w.state == DIRECT:
+            w.state = IDLE
+            w.last_seen = time.monotonic()
+        if revoked:
+            self.builtin_metrics.lease_revocations.inc(
+                tags={"reason": reason})
+        self._kick()
+
+    async def _revoke_lease(self, lease_id: bytes, reason: str):
+        """Ask the owner to stop using (and return) a lease; force-reclaim
+        after a short deadline so a wedged client can't pin the slot.
+        The grant only frees at lease_return (or the deadline): in-flight
+        specs already pipelined to the worker drain first."""
+        lease = self.leases.get(lease_id)
+        if lease is None or lease["revoke_deadline"] is not None:
+            return
+        lease["revoke_deadline"] = time.monotonic() + 2.0
+        self._event("lease_revoke", lease=lease_id.hex(), reason=reason)
+        c = self.server.connections.get(lease["conn_id"])
+        if c is None:
+            self._finalize_lease(lease_id, reason, revoked=True)
+            return
+        try:
+            await c.push("lease_revoke",
+                         {"lease_id": lease_id, "reason": reason})
+        except Exception:
+            self._finalize_lease(lease_id, reason, revoked=True)
+
+    async def h_lease_return(self, conn, body):
+        for raw in body.get("lease_ids", []):
+            lease = self.leases.get(bytes(raw))
+            # Only the owner returns a lease: a confused client must not
+            # release someone else's slot.
+            if lease is not None and lease["conn_id"] == conn.conn_id:
+                revoked = lease["revoke_deadline"] is not None
+                self._finalize_lease(bytes(raw), "revoked" if revoked
+                                     else "returned", revoked=revoked)
+        return {}
+
+    async def h_lease_renew(self, conn, body):
+        now = time.monotonic()
+        for raw in body.get("lease_ids", []):
+            lease = self.leases.get(bytes(raw))
+            if lease is not None and lease["conn_id"] == conn.conn_id \
+                    and lease["revoke_deadline"] is None:
+                lease["expires"] = now + self.config.lease_ttl_s
+        return {}
+
     # -- worker death / fault tolerance ---------------------------------------
 
     async def _handle_worker_death(self, worker_id: WorkerID):
@@ -2730,6 +3071,20 @@ class Head:
         if worker is None:
             return
         worker.state = DEAD
+        # A leased slot dies with its worker: release the resources now and
+        # tell the owner so it drops the slot (its in-flight specs fail on
+        # the peer connection and fall back to the head path).
+        for lease_id, lease in list(self.leases.items()):
+            if lease["worker_id"] == worker_id:
+                c = self.server.connections.get(lease["conn_id"])
+                self._finalize_lease(lease_id, "worker_died", revoked=True)
+                if c is not None:
+                    try:
+                        await c.push("lease_revoke", {
+                            "lease_id": lease_id, "reason": "worker_died",
+                        })
+                    except Exception:
+                        pass
         self._log_mark_dead(worker_id.hex())
         oom_killed = self._oom_kills.pop(worker_id, None) is not None
         self.node_worker_counts[worker.node_id] = max(
@@ -2841,6 +3196,10 @@ class Head:
                     await self._publish(
                         f"actor:{actor.actor_id.hex()}", {"state": "RESTARTING"}
                     )
+                    # Invalidate cached peer routes: the restarted actor
+                    # lands on a NEW worker (stale-incarnation calls to the
+                    # old address also self-detect, this is the fast path).
+                    await self._publish_actor_event(actor, "RESTARTING")
                     # Re-submit the creation task
                     # (reference: gcs_actor_manager.cc RestartActor).  The
                     # orphaned running record shares the task id; drop its
@@ -2863,6 +3222,7 @@ class Head:
                     await self._publish(
                         f"actor:{actor.actor_id.hex()}", {"state": "DEAD"}
                     )
+                    await self._publish_actor_event(actor, "DEAD")
                     await self._fail_actor_queue(actor, None)
                     self._free_actor_creation_args(actor)
         self._kick()

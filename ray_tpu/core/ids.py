@@ -18,6 +18,44 @@ from ..devtools.locks import make_lock
 _ID_LEN = 16  # bytes of entropy per ID
 _OBJECT_INDEX_LEN = 4  # trailing bytes of an ObjectID encode the return index
 
+# os.urandom is a syscall per call — on sandboxed kernels it costs close to
+# a millisecond, and ID generation sits on the task-submission hot path
+# (one TaskID + one ObjectID per call).  A process-local PRNG seeded ONCE
+# from os.urandom keeps the entropy while making subsequent IDs pure
+# userspace.  Fork safety: a forked child (zygote workers) inheriting the
+# parent's PRNG stream would mint colliding IDs, so the stream resets in
+# the child via the at-fork hook (os.getpid() per ID would be another
+# syscall on the hot path).
+_rng = None
+_rng_lock = threading.Lock()
+
+
+def _reset_rng():
+    global _rng
+    _rng = None
+
+
+os.register_at_fork(after_in_child=_reset_rng)
+
+
+def _rand_bytes(n: int) -> bytes:
+    global _rng
+    rng = _rng
+    if rng is None:
+        import random
+        import time as _time
+
+        with _rng_lock:
+            if _rng is None:
+                _rng = random.Random(
+                    os.urandom(16)
+                    + os.getpid().to_bytes(8, "little", signed=False)
+                    + _time.time_ns().to_bytes(16, "little", signed=False)
+                )
+            rng = _rng
+    with _rng_lock:
+        return rng.getrandbits(8 * n).to_bytes(n, "little")
+
 
 class BaseID:
     """Immutable, hashable identifier backed by raw bytes."""
@@ -40,7 +78,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.byte_len()))
+        return cls(_rand_bytes(cls.byte_len()))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -116,7 +154,7 @@ class ObjectID(BaseID):
     @classmethod
     def from_random(cls):
         # Driver `put()` objects get a synthetic task id of all-random bytes.
-        return cls(os.urandom(cls.byte_len()))
+        return cls(_rand_bytes(cls.byte_len()))
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[:_ID_LEN])

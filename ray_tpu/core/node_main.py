@@ -365,6 +365,13 @@ class NodeDaemon:
             RT_HEAD_ADDR=self.head_addr,
             RT_NODE_ID=self.node_id.hex(),
             RT_SESSION=self.session,
+            # Peer-plane wiring: workers bind their peer RPC server on this
+            # node's host and stamp the node's object-plane endpoints into
+            # direct-call result descriptors (cross-node readers pull
+            # straight from here, no directory lookup).
+            RT_PEER_HOST=self.host,
+            RT_OBJECT_ADDR=f"{self.host}:{self.server.port}",
+            RT_BULK_ADDR=f"{self.host}:{self.bulk_server.port}",
             JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
         )
         return env

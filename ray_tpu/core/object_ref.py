@@ -35,14 +35,7 @@ def _flush_free_queue(background: bool = False):
                 # the pipelined call keeps frees prompt so large freed
                 # segments return to the store pool instead of forcing
                 # eviction/spill of live objects.
-                import time as _time
-
-                for raw in batch:
-                    ctx.client._local_drop(ObjectID(raw))
-                    if raw in ctx.client.large_oids:
-                        ctx.client._last_large_free = _time.monotonic()
-                    ctx.client.large_oids.discard(raw)
-                ctx.client.call_bg("free_objects", {"object_ids": batch})
+                ctx.client.free_objects_bg(batch)
             else:
                 ctx.client.free_objects(batch)
         except Exception:
@@ -96,6 +89,10 @@ class ObjectRef:
         # The sender bumps the count so the object outlives the transfer
         # (simplified borrowing vs reference_count.h's full protocol).
         if ctx.client is not None:
+            # Direct-call results live only in the sender's local cache
+            # until shared: register head-side first so the receiver's
+            # get() has a record to seal against.
+            ctx.client.ensure_shared(self._id.binary())
             ctx.client.add_reference(self._id.binary())
         return (_reconstruct_ref, (self._id.binary(),))
 

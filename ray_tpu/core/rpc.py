@@ -78,16 +78,39 @@ class Connection:
         self.meta: Dict[str, Any] = {}
         self.alive = True
         self._write_lock = asyncio.Lock()
+        # Response/push coalescing: frames buffer here and ONE call_soon
+        # flush per loop tick writes them all — a burst of completions
+        # costs one send() syscall, not one per frame (send() is ~1ms on
+        # sandboxed kernels and bounds per-connection message rate).
+        self._outbuf = bytearray()
+        self._flush_scheduled = False
 
     async def push(self, method: str, body: Any):
-        async with self._write_lock:
-            self.writer.write(_encode([PUSH, 0, method, body]))
-            await self.writer.drain()
+        await self._send([PUSH, 0, method, body])
 
     async def _send(self, msg):
-        async with self._write_lock:
-            self.writer.write(_encode(msg))
-            await self.writer.drain()
+        self._outbuf += _encode(msg)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self):
+        self._flush_scheduled = False
+        data, self._outbuf = bytes(self._outbuf), bytearray()
+        if not data or not self.alive:
+            return
+        try:
+            self.writer.write(data)
+            # Coalesced writes skip drain() (its await would serialize the
+            # burst) — so bound the transport buffer explicitly: a peer
+            # that stopped reading must not grow server memory without
+            # limit.  Closing trips the normal disconnect cleanup; the
+            # health prober would have reaped such a peer anyway.
+            if self.writer.transport.get_write_buffer_size() \
+                    > RpcServer.STREAM_LIMIT:
+                self.writer.close()
+        except Exception:
+            pass  # reader side notices the dead transport
 
 
 class RpcServer:
@@ -184,17 +207,35 @@ class RpcClient:
     Push handlers run on the loop; long handlers must hand off to a thread.
     """
 
-    def __init__(self, host: str, port: int, name: str = "rpc-client"):
+    def __init__(self, host: str, port: int, name: str = "rpc-client",
+                 connect_timeout_s: Optional[float] = None,
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
         self.host = host
         self.port = port
-        self._loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(
-            target=self._loop.run_forever, name=name, daemon=True
-        )
-        self._thread.start()
+        # ``loop``: run on a caller-owned shared loop instead of spawning a
+        # thread per connection — the peer dataplane multiplexes many
+        # worker connections over ONE loop thread (a reader thread per
+        # connection thrashes small hosts).  close() leaves a shared loop
+        # running.
+        self._owns_loop = loop is None
+        if loop is not None:
+            self._loop = loop
+            self._thread = None
+        else:
+            self._loop = asyncio.new_event_loop()
+            self._thread = threading.Thread(
+                target=self._loop.run_forever, name=name, daemon=True
+            )
+            self._thread.start()
         self._seq = 0
         self._seq_lock = make_lock("rpc.seq")
-        self._pending: Dict[int, asyncio.Future] = {}
+        self._pending: Dict[int, Any] = {}
+        # Outbox coalescing: requests append here and at most ONE loop
+        # wakeup is scheduled at a time.  call_soon_threadsafe costs a
+        # self-pipe write syscall (~1ms on sandboxed kernels); a burst of N
+        # submissions must pay it once, not N times.
+        self._outbox: list = []
+        self._outbox_scheduled = False
         self._push_handlers: Dict[str, Callable[[Any], None]] = {}
         self._writer = None
         self._write_lock = None
@@ -205,7 +246,12 @@ class RpcClient:
 
         fut = asyncio.run_coroutine_threadsafe(self._connect(), self._loop)
         try:
-            fut.result(timeout=get_config().rpc_connect_timeout_s)
+            # Peer-plane dials pass a short timeout: a dead worker's stale
+            # address must fail fast into the head fallback, not stall the
+            # caller for the full control-plane connect window.
+            fut.result(timeout=connect_timeout_s
+                       if connect_timeout_s is not None
+                       else get_config().rpc_connect_timeout_s)
         except BaseException:
             # A failed dial must not leak the loop thread started above:
             # callers that probe-and-retry (Cluster.attach fail-fast,
@@ -246,6 +292,7 @@ class RpcClient:
             pass
         finally:
             self.closed = True
+            self._fail_outbox()
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionLost("connection lost"))
@@ -259,33 +306,85 @@ class RpcClient:
     def on_push(self, method: str, handler: Callable[[Any], None]):
         self._push_handlers[method] = handler
 
-    async def _send_request(self, seq, method, body):
-        fut = asyncio.get_running_loop().create_future()
-        self._pending[seq] = fut
-        async with self._write_lock:
-            self._writer.write(_encode([REQ, seq, method, body]))
-            await self._writer.drain()
-        return await fut
+    def _fail_outbox(self):
+        with self._seq_lock:
+            stranded, self._outbox = self._outbox, []
+            self._outbox_scheduled = False
+        for _, _, _, fut in stranded:
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection lost"))
+
+    def _drain_outbox(self):
+        """Loop thread: encode and write every queued request.  Loops until
+        the outbox is observed empty with the scheduled flag still set, so
+        a producer racing the drain never schedules a second wakeup — one
+        self-pipe write per burst, however long the burst."""
+        for _ in range(64):
+            with self._seq_lock:
+                batch, self._outbox = self._outbox, []
+                if not batch:
+                    self._outbox_scheduled = False
+                    return
+            data = bytearray()
+            written: list = []
+            for seq, method, body, fut in batch:
+                if fut.done():
+                    continue  # e.g. cancelled while queued
+                try:
+                    frame = _encode([REQ, seq, method, body])
+                except Exception as e:  # oversized message etc.
+                    fut.set_exception(e)
+                    continue
+                self._pending[seq] = fut
+                written.append(seq)
+                data += frame
+            if not data:
+                continue
+            try:
+                self._writer.write(bytes(data))
+                # Same buffer bound as Connection._flush: a server that
+                # stopped reading must not grow this process's memory
+                # without limit — close, and the read loop's teardown
+                # fails every pending future with ConnectionLost.
+                if self._writer.transport.get_write_buffer_size() \
+                        > RpcServer.STREAM_LIMIT:
+                    self._writer.close()
+            except Exception as e:
+                for seq in written:
+                    fut = self._pending.pop(seq, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(ConnectionLost(str(e)))
+        # Producer still ahead of us after many passes: yield one loop
+        # iteration (reads must not starve) and keep the flag claimed.
+        self._loop.call_soon(self._drain_outbox)
 
     def call(self, method: str, body: Any = None, timeout: float = 60.0) -> Any:
         if self.closed:
             raise ConnectionLost("client is closed")
-        with self._seq_lock:
-            self._seq += 1
-            seq = self._seq
-        fut = asyncio.run_coroutine_threadsafe(
-            self._send_request(seq, method, body), self._loop
-        )
-        return fut.result(timeout=timeout)
+        return self.call_async(method, body).result(timeout=timeout)
 
     def call_async(self, method: str, body: Any = None):
-        """Fire a request, return a concurrent.futures.Future."""
+        """Fire a request, return a concurrent.futures.Future.  Requests
+        coalesce through the outbox; ordering across call()/call_async()
+        is the append order (single connection, FIFO)."""
+        import concurrent.futures as _cf
+
+        fut: _cf.Future = _cf.Future()
+        if self.closed:
+            fut.set_exception(ConnectionLost("client is closed"))
+            return fut
         with self._seq_lock:
             self._seq += 1
-            seq = self._seq
-        return asyncio.run_coroutine_threadsafe(
-            self._send_request(seq, method, body), self._loop
-        )
+            self._outbox.append((self._seq, method, body, fut))
+            wake = not self._outbox_scheduled
+            if wake:
+                self._outbox_scheduled = True
+        if wake:
+            try:
+                self._loop.call_soon_threadsafe(self._drain_outbox)
+            except RuntimeError:  # loop already closed (shutdown race)
+                self._fail_outbox()
+        return fut
 
     def close(self):
         if self.closed:
@@ -296,6 +395,8 @@ class RpcClient:
         self.on_connection_lost = None
 
         def _shutdown():
+            self._drain_outbox()  # flush straggler fire-and-forget requests
+
             async def _graceful():
                 task = self._reader_task
                 if task is not None:
@@ -308,12 +409,17 @@ class RpcClient:
                         pass
                 if self._writer is not None:
                     self._writer.close()
-                self._loop.stop()
+                if self._owns_loop:
+                    self._loop.stop()
 
             asyncio.ensure_future(_graceful())
 
-        self._loop.call_soon_threadsafe(_shutdown)
-        self._thread.join(timeout=5)
+        try:
+            self._loop.call_soon_threadsafe(_shutdown)
+        except RuntimeError:
+            return  # shared loop already stopped
+        if self._owns_loop and self._thread is not None:
+            self._thread.join(timeout=5)
 
 
 class ServerThread:

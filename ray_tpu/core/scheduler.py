@@ -80,6 +80,12 @@ class NodeState:
     # *admission*; this pool assigns the concrete device indices a granted
     # task may see (reference: tpu.py:155 TPU_VISIBLE_CHIPS isolation).
     tpu_free: List[int] = dataclasses.field(default_factory=list)
+    # Execution slots leased out to clients for direct (head-bypassing)
+    # task submission.  Their resources are held in `available` like any
+    # running task's; this count keeps the load visible to introspection
+    # and the autoscaler even though the per-task traffic never transits
+    # the head (reference: raylet worker leases are resources in use).
+    leased_slots: int = 0
 
     @property
     def schedulable(self) -> bool:
@@ -159,6 +165,26 @@ class ClusterScheduler:
         if node is not None:
             node.tpu_free.extend(c for c in chips if c not in node.tpu_free)
             node.tpu_free.sort()
+
+    def lease_slot(self, node_id: NodeID, resources: ResourceDict) -> bool:
+        """Reserve one direct-submission execution slot on a node (the
+        lease-table analog of a task acquire).  Draining/dead nodes never
+        grant: a lease outliving the node would hand the client a doomed
+        endpoint."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.schedulable:
+            return False
+        if not _fits(node.available, resources):
+            return False
+        _sub(node.available, resources)
+        node.leased_slots += 1
+        return True
+
+    def release_slot(self, node_id: NodeID, resources: ResourceDict) -> None:
+        node = self.nodes.get(node_id)
+        if node is not None:
+            _add(node.available, resources)
+            node.leased_slots = max(0, node.leased_slots - 1)
 
     def mark_draining(self, node_id: NodeID) -> bool:
         """Announced preemption: stop NEW placements on the node while its
@@ -516,6 +542,7 @@ class ClusterScheduler:
                     "labels": n.labels,
                     "alive": n.alive,
                     "draining": n.draining,
+                    "leased_slots": n.leased_slots,
                 }
                 for n in self.nodes.values()
             },

@@ -82,6 +82,23 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "get_log": (("proc_id", str),),
     "stack_dump": (("worker_id", str),),
     "stack_dump_reply": (("token", _NUM), ("dump", str)),
+    # -- dataplane: peer-to-peer calls + node-local task leases ---------------
+    # resolve_actor is a pure read (idempotent) but keeps a row so the
+    # address-resolution wire shape is owned here like every other method.
+    "resolve_actor": (("actor_id", _BYTES),),
+    "lease_request": (("resources", dict), ("count", _NUM)),
+    "lease_return": (("lease_ids", list),),
+    "lease_renew": (("lease_ids", list),),
+    # Batched completion report for directly-executed tasks (telemetry +
+    # task history; object registration rides the submitter's put batch).
+    "direct_done": (("task_id", _BYTES),),
+    # Worker-plane peer RPCs.  Their servers live in worker processes,
+    # outside the head's _validated wrapper — the handlers validate these
+    # rows in-handler, mirroring pull_object/read_log.
+    "peer_submit": (("spec", dict), ("worker_id", _BYTES)),
+    "peer_next_stream_item": (("task_id", _BYTES), ("index", _NUM),
+                              ("worker_id", _BYTES)),
+    "peer_cancel": (("task_id", _BYTES),),
 }
 
 

@@ -142,10 +142,16 @@ class HeadMetrics:
             "ray_tpu_object_store_hit_rate",
             "Fraction of store reads served from shm (vs miss/spill), cluster-wide",
             register=False)
+        self.lease_revocations = Counter(
+            "ray_tpu_lease_revocations_total",
+            "Task-lease revocations (TTL expiry, node drain, worker death, "
+            "or scheduler preemption of idle-held slots)",
+            tag_keys=("reason",), register=False)
         self._all = [
             self.submit_to_start, self.queue_depth, self.tasks_dispatched,
             self.task_duration, self.store_used, self.store_capacity,
             self.store_stored, self.store_transferred, self.store_hit_rate,
+            self.lease_revocations,
         ]
 
     def sample_store(self, stats: dict) -> None:

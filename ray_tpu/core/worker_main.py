@@ -144,10 +144,34 @@ class _LogTee:
 class Worker:
     def __init__(self):
         from .node_main import own_log_path
+        from .rpc import RpcServer, ServerThread
 
         self.head_addr = os.environ["RT_HEAD_ADDR"]
         self.node_id = bytes.fromhex(os.environ["RT_NODE_ID"])
         self.worker_id = os.urandom(16)
+        # Peer RPC server: the direct-dataplane endpoint.  Drivers (and
+        # other workers) submit actor calls and leased tasks HERE, never
+        # through the head (reference: core_worker.proto PushTask — core
+        # workers push tasks to each other directly).  Started before
+        # registration so the head learns the address atomically with the
+        # worker record; zygote-forked workers therefore come up with a
+        # live peer endpoint before their first lease/call.
+        self.direct_streams: Dict[bytes, dict] = {}
+        peer_host = os.environ.get("RT_PEER_HOST", "127.0.0.1")
+        self.peer_server = RpcServer(host=peer_host)
+        self.peer_server.register("peer_submit", self.h_peer_submit)
+        self.peer_server.register("peer_next_stream_item",
+                                  self.h_peer_next_stream_item)
+        self.peer_server.register("peer_cancel", self.h_peer_cancel)
+        self.peer_thread = ServerThread(self.peer_server)
+        peer_port = self.peer_thread.start()
+        # Direct-reply coalescing: completions buffer here and one
+        # call_soon_threadsafe per batch wakes the peer loop (the self-pipe
+        # wakeup is a syscall; per-completion wakeups would bound direct
+        # throughput at ~1k/s on sandboxed kernels).
+        self._direct_replies: list = []
+        self._direct_replies_lock = make_lock("worker.direct_replies")
+        self._direct_replies_scheduled = False
         self.client = Client(
             self.head_addr,
             kind="worker",
@@ -160,6 +184,7 @@ class Worker:
             # Cluster log index entry: `get_log` serves this file from any
             # machine, even after this process dies.
             log_path=own_log_path(),
+            peer_addr=f"{peer_host}:{peer_port}",
         )
         ctx.client = self.client
         ctx.mode = "worker"
@@ -484,6 +509,11 @@ class Worker:
 
     def _report_done(self, spec, returns=None, error=None, retryable=False,
                      error_repr="", error_tb="", stream_count=0):
+        direct_reply = spec.pop("_direct_reply", None)
+        if direct_reply is not None:
+            self._reply_direct(spec, direct_reply, returns or [], error,
+                               retryable, error_repr, error_tb, stream_count)
+            return
         body = {
             "task_id": spec["task_id"],
             "returns": returns or [],
@@ -520,6 +550,154 @@ class Worker:
                       flush=True)
             os._exit(1)
 
+    def _reply_direct(self, spec, direct_reply, returns, error, retryable,
+                      error_repr, error_tb, stream_count):
+        """Complete a peer-submitted task: the result travels BACK over the
+        peer connection (the submitter seals it locally and owns the object
+        registration), while a batched ``direct_done`` report keeps the
+        head's task history, timeline, and actor accounting complete —
+        telemetry without per-call dispatch."""
+        loop, fut = direct_reply
+        body = {
+            "returns": returns,
+            "stream_count": stream_count,
+            "session": self.client.session,
+            "node_id": self.node_id,
+        }
+        if error is not None:
+            body["error"] = error
+            body["retryable"] = retryable
+            body["error_repr"] = error_repr
+            body["error_tb"] = error_tb
+        st = self.direct_streams.get(spec["task_id"])
+        if st is not None:
+            st["done"] = stream_count
+            if error is not None:
+                st["error"] = error
+
+        with self._direct_replies_lock:
+            self._direct_replies.append((fut, body))
+            wake = not self._direct_replies_scheduled
+            if wake:
+                self._direct_replies_scheduled = True
+        if wake:
+            try:
+                loop.call_soon_threadsafe(self._drain_direct_replies)
+            except RuntimeError:
+                pass  # peer loop shutting down with the process
+        done = {
+            "task_id": spec["task_id"],
+            "name": spec.get("name", ""),
+            "failed": error is not None,
+            "start": spec.get("_exec_start", 0.0),
+            "end": time.time(),
+        }
+        if spec.get("actor_id"):
+            done["actor_id"] = spec["actor_id"]
+        if error is not None:
+            done["error_repr"] = error_repr
+            done["error_tb"] = error_tb
+        try:
+            # Batched background report — the run loop's idle flush and the
+            # client's safety-net flusher bound its latency; nothing blocks
+            # on it (the caller already has the result).
+            self.client.call_batched("direct_done", done)
+        except Exception:
+            pass
+
+    def _drain_direct_replies(self):
+        """Peer loop thread: resolve every buffered completion (their
+        ``h_peer_submit`` coroutines then send responses, which the
+        Connection's write coalescer folds into one socket write).  Loops
+        until observed empty with the flag still claimed so a completion
+        racing the drain never pays a second wakeup."""
+        while True:
+            with self._direct_replies_lock:
+                batch, self._direct_replies = self._direct_replies, []
+                if not batch:
+                    self._direct_replies_scheduled = False
+                    return
+            for fut, body in batch:
+                if not fut.done():
+                    fut.set_result(body)
+
+    # -- peer dataplane server (direct actor calls + leased submissions) ------
+
+    @staticmethod
+    def _peer_validate(method: str, body):
+        """In-handler schema validation: peer servers register outside the
+        head's ``_validated`` wrapper, mirroring pull_object/read_log — the
+        boundary guarantee must hold on every server speaking the method."""
+        from . import schema as wire_schema
+        from .rpc import RpcError
+
+        try:
+            wire_schema.validate(method, body)
+        except wire_schema.SchemaError as e:
+            raise RpcError(str(e)) from None
+
+    async def h_peer_submit(self, conn, body):
+        """Direct task submission from a peer (driver or another worker).
+        The spec enters the same task queue head-pushed specs use, so
+        arrival order — per-connection FIFO — is execution order for sync
+        actors, and the reply resolves when the task completes."""
+        self._peer_validate("peer_submit", body)
+        if body["worker_id"] != self.worker_id:
+            # Stale route: the caller resolved an address this process no
+            # longer answers for (recycled port after a restart, confused
+            # cache).  Refuse — executing would run on the wrong worker.
+            return {"stale": True}
+        spec = body["spec"]
+        if spec.get("actor_id") and spec["actor_id"] != self.actor_id:
+            # Stale incarnation: this process never hosted (or no longer
+            # hosts) that actor — the caller must re-resolve via the head.
+            return {"stale": True}
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        spec["_direct_reply"] = (loop, fut)
+        if spec.get("num_returns") == "streaming":
+            if len(self.direct_streams) > 256:
+                # Bound retained stream state: shed fully-reported streams
+                # whose consumer never drained to the end.
+                for tid in list(self.direct_streams):
+                    if self.direct_streams[tid]["done"] is not None:
+                        del self.direct_streams[tid]
+                    if len(self.direct_streams) <= 256:
+                        break
+            self.direct_streams[spec["task_id"]] = {
+                "items": [], "done": None, "error": None,
+            }
+        self.task_queue.put(spec)
+        return await fut
+
+    async def h_peer_next_stream_item(self, conn, body):
+        """Direct-result streaming: the submitter pulls a streaming task's
+        yielded items straight from the executing worker (head path analog:
+        h_next_stream_item)."""
+        self._peer_validate("peer_next_stream_item", body)
+        if body["worker_id"] != self.worker_id:
+            return {"stale": True}
+        task_id = body["task_id"]
+        index = int(body["index"])
+        while True:
+            st = self.direct_streams.get(task_id)
+            if st is None:
+                return {"done": True}
+            if index < len(st["items"]):
+                return {"item": st["items"][index]}
+            if st["error"] is not None:
+                return {"error": st["error"]}
+            if st["done"] is not None:
+                # Fully consumed: drop the retained stream state.
+                self.direct_streams.pop(task_id, None)
+                return {"done": True}
+            await asyncio.sleep(0.005)
+
+    async def h_peer_cancel(self, conn, body):
+        self._peer_validate("peer_cancel", body)
+        self._on_cancel(body)
+        return {"cancelled": True}
+
     # -------------------------------------------------------------- execution
 
     def _execute(self, spec):
@@ -527,6 +705,7 @@ class Worker:
         if _DEBUG_PUSH:
             print(f"EXEC start {spec.get('name')} {task_id.hex()[:8]}",
                   file=sys.stderr, flush=True)
+        spec["_exec_start"] = time.time()
         ctx.current_task_id = TaskID(task_id)
         self.running_threads[task_id] = threading.get_ident()
         saved_env: Dict[str, Optional[str]] = {}
@@ -660,14 +839,23 @@ class Worker:
             result = fn(*args, **kwargs)
 
             if spec.get("num_returns") == "streaming":
+                direct = "_direct_reply" in spec
                 count = 0
                 for item in result:
                     oid = ObjectID.for_task_return(TaskID(task_id), count + 1000)
                     info = self._store_value(oid, item)
-                    self.client.call_bg(
-                        "stream_item",
-                        {"task_id": task_id, "index": count, **info},
-                    )
+                    if direct:
+                        # Peer-submitted stream: items stay here and the
+                        # submitter pulls them via peer_next_stream_item —
+                        # no per-item head traffic.
+                        st = self.direct_streams.get(task_id)
+                        if st is not None:
+                            st["items"].append(info)
+                    else:
+                        self.client.call_bg(
+                            "stream_item",
+                            {"task_id": task_id, "index": count, **info},
+                        )
                     count += 1
                 self._report_done(spec, returns=[], stream_count=count)
                 return

@@ -7,12 +7,18 @@ This framework ships msgpack dicts, so the three legs of each method
 apart silently.  RT003 reconciles them statically:
 
 - every method the package calls must have an ``h_<method>`` handler in
-  ``core/head.py`` or ``core/node_main.py``;
+  ``core/head.py``, ``core/node_main.py``, or ``core/worker_main.py``
+  (the worker-plane peer servers — direct actor calls, leased task
+  submission, direct-result streaming — are RPC surface like any other);
 - every method ``core/client.py`` sends that can mutate head state (i.e.
   is not in its ``IDEMPOTENT_METHODS`` read set) must have a
   ``schema.REQUIRED`` row so the boundary validates it;
 - no orphan schema rows (row without a handler);
 - no orphan handlers (handler no code calls — dead wire surface).
+
+Handlers on node/worker servers register outside the head's ``_validated``
+wrapper, so they must validate their schema rows in-handler (mirroring
+``pull_object``/``read_log``).
 """
 
 from __future__ import annotations
@@ -62,13 +68,14 @@ def check_rt003(project: Project) -> List[Finding]:
     client = project.find("core/client.py")
     head = project.find("core/head.py")
     node = project.find("core/node_main.py")
+    worker = project.find("core/worker_main.py")
     schema = project.find("core/schema.py")
     if client is None or head is None or schema is None:
         return []  # not a control-plane tree (synthetic single-rule runs)
     out: List[Finding] = []
 
     handlers: Dict[str, Tuple[str, int]] = {}
-    for mod in (head, node) if node is not None else (head,):
+    for mod in (m for m in (head, node, worker) if m is not None):
         for name, line in _handlers(mod).items():
             handlers.setdefault(name, (mod.rel, line))
 
@@ -98,7 +105,8 @@ def check_rt003(project: Project) -> List[Finding]:
             out.append(Finding(
                 "RT003", rel, line,
                 f"RPC {method!r} is called but no h_{method} handler "
-                "exists in core/head.py or core/node_main.py",
+                "exists in core/head.py, core/node_main.py, or "
+                "core/worker_main.py",
             ))
 
     # Leg 2: every mutating method the PACKAGE sends carries a schema row

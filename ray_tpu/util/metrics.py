@@ -55,6 +55,10 @@ BUILTIN_METRICS: Dict[str, str] = {
     # autoscaler (autoscaler/__init__.py)
     "ray_tpu_autoscaler_demand": "gauge",
     "ray_tpu_autoscaler_decisions_total": "counter",
+    # dataplane (core/dataplane.py client-side; core/telemetry.py head-side)
+    "ray_tpu_direct_calls_total": "counter",
+    "ray_tpu_leased_tasks_total": "counter",
+    "ray_tpu_lease_revocations_total": "counter",
     # logging plane (core/worker_main.py)
     "ray_tpu_logs_dropped_total": "counter",
 }
