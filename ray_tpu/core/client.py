@@ -21,7 +21,7 @@ from .config import get_config
 from .ids import NodeID, ObjectID
 from .object_store import StoreClient
 from .rpc import ConnectionLost, RpcClient
-from ..devtools.locks import make_lock
+from ..devtools.locks import guarded, make_lock
 
 # Head RPCs that are safe to retry on a transient connection hiccup: pure
 # reads (no head-side state mutation), so a duplicate delivery is harmless
@@ -38,7 +38,27 @@ IDEMPOTENT_RETRY_ATTEMPTS = 3
 IDEMPOTENT_RETRY_BASE_S = 0.05
 
 
+@guarded
 class Client:
+    # rtlint RT007 verifies these statically; RT_DEBUG_LOCKS=2 asserts the
+    # guards at runtime (devtools.locks).  large_oids/_last_large_free ride
+    # _local_lock: they are updated on the same put/free paths that touch
+    # the in-process store.
+    _RT_GUARDED_BY = {
+        "_local_bytes": "_local_lock",
+        "large_oids": "_local_lock",
+        "_last_large_free": "_local_lock",
+        "_bg_exc": "_bg_lock",
+        "_put_batch": "_put_batch_lock",
+        "_submit_batch": "_submit_batch_lock",
+        "_stores": "_stores_lock",
+    }
+    _RT_UNGUARDED = {
+        "rpc": "reconnect swaps in a fresh RpcClient with one reference "
+               "store; racing readers use the dying client once more and "
+               "retry through call()'s idempotent-retry path",
+    }
+
     def __init__(
         self,
         head_addr: str,
@@ -93,7 +113,11 @@ class Client:
         if self.proxy:
             self.session = f"{self.session}-proxy{os.getpid()}"
         self.kind = kind
+        # Per-session store clients: created lazily from whatever thread
+        # first touches a session (user threads, push handlers on the rpc
+        # loop, the free flusher).
         self._stores: Dict[str, StoreClient] = {}
+        self._stores_lock = make_lock("client.stores")
         # In-process store for small objects this process owns or has read
         # (packed blobs, LRU-bounded).  The analog of the reference's
         # CoreWorkerMemoryStore (src/ray/core_worker/store_provider/
@@ -178,10 +202,15 @@ class Client:
 
     def store(self, session: Optional[str] = None) -> StoreClient:
         session = session or self.session
-        st = self._stores.get(session)
-        if st is None:
-            st = self._stores[session] = StoreClient(session)
-        return st
+        with self._stores_lock:
+            st = self._stores.get(session)
+            if st is None:
+                st = self._stores[session] = StoreClient(session)
+            return st
+
+    def _stores_snapshot(self) -> List[StoreClient]:
+        with self._stores_lock:
+            return list(self._stores.values())
 
     def _on_object_free(self, body):
         dirty: List[bytes] = []
@@ -191,7 +220,7 @@ class Client:
             oid = ObjectID(raw)
             self._local_drop(oid)
             clean = True
-            for st in self._stores.values():
+            for st in self._stores_snapshot():
                 had = oid in st._attached
                 if not st.detach(oid):
                     clean = False
@@ -257,11 +286,21 @@ class Client:
         self._call_bg_raw(method, body)
 
     def _call_bg_raw(self, method: str, body: Any):
+        # Reap/wait OUTSIDE the lock: the backpressure wait can block up
+        # to 60s, and check_bg (every sync call) takes _bg_lock — holding
+        # it here would stall the whole client behind one backlogged RPC.
+        done_futs: List[Any] = []
+        wait_fut = None
         with self._bg_lock:
             while self._bg_futs and self._bg_futs[0].done():
-                self._note_bg_exc(self._bg_futs.popleft())
+                done_futs.append(self._bg_futs.popleft())
             if len(self._bg_futs) >= 1000:
-                self._note_bg_exc(self._bg_futs.popleft(), wait=True)
+                wait_fut = self._bg_futs.popleft()
+        for fut in done_futs:
+            self._note_bg_exc(fut)
+        if wait_fut is not None:
+            self._note_bg_exc(wait_fut, wait=True)
+        with self._bg_lock:
             self._bg_futs.append(self.rpc.call_async(method, body))
 
     def _flush_put_batch(self):
@@ -292,6 +331,8 @@ class Client:
             self._call_bg_raw("batch", {"entries": batch})
 
     def _note_bg_exc(self, fut, wait: bool = False):
+        """Record a background failure.  Never called with _bg_lock held —
+        the wait=True path blocks on the head for up to 60s."""
         try:
             if wait:
                 fut.result(timeout=60)
@@ -301,11 +342,13 @@ class Client:
         except BaseException as e:  # noqa: BLE001
             exc = e
         if exc is not None and not isinstance(exc, ConnectionLost):
-            self._bg_exc = exc
+            with self._bg_lock:
+                self._bg_exc = exc
 
     def check_bg(self):
         """Raise (once) a deferred error from the background pipeline."""
-        exc, self._bg_exc = self._bg_exc, None
+        with self._bg_lock:
+            exc, self._bg_exc = self._bg_exc, None
         if exc is not None:
             raise exc
 
@@ -370,7 +413,8 @@ class Client:
                 f.result(timeout=timeout)
             except BaseException as e:  # noqa: BLE001
                 if not isinstance(e, ConnectionLost):
-                    self._bg_exc = e
+                    with self._bg_lock:
+                        self._bg_exc = e
         self.check_bg()
 
     # -- objects ---------------------------------------------------------------
@@ -420,12 +464,13 @@ class Client:
             # segments are on their way to the pool (free -> detach-ack ->
             # pool, a few ms): a short wait claims warm pages instead of
             # paying cold first-touch faults.
-            wait = (
-                0.06 if time.monotonic() - self._last_large_free < 0.5 else 0.0
-            )
+            with self._local_lock:
+                recent = time.monotonic() - self._last_large_free < 0.5
+            wait = 0.06 if recent else 0.0
             buf = self.store().create(oid, size, wait_pool_s=wait)
             serialization.pack_into(meta, buffers, buf)
-            self.large_oids.add(oid.binary())
+            with self._local_lock:
+                self.large_oids.add(oid.binary())
             self.call_bg(
                 "put_object",
                 {"object_id": oid.binary(), "size": size,
@@ -836,12 +881,21 @@ class Client:
             )
         return set(reply["ready"])
 
+    def _note_frees(self, raw_ids: List[bytes]):
+        """Local-store drops + large-segment free timestamps for a free
+        batch, under one _local_lock pass (the free flusher thread and
+        user threads both reach here)."""
+        with self._local_lock:
+            for raw in raw_ids:
+                blob = self._local.pop(ObjectID(raw), None)
+                if blob is not None:
+                    self._local_bytes -= len(blob)
+                if raw in self.large_oids:
+                    self._last_large_free = time.monotonic()
+                    self.large_oids.discard(raw)
+
     def free_objects(self, raw_ids: List[bytes]):
-        for raw in raw_ids:
-            self._local_drop(ObjectID(raw))
-            if raw in self.large_oids:
-                self._last_large_free = time.monotonic()
-            self.large_oids.discard(raw)
+        self._note_frees(raw_ids)
         if self._dataplane is not None:
             # Drop cached direct results; defer frees of args pinned by
             # in-flight direct calls (released at call completion).
@@ -858,11 +912,7 @@ class Client:
     def free_objects_bg(self, raw_ids: List[bytes]):
         """Pipelined free for the ObjectRef GC flusher: local drops +
         dataplane interception, then a fire-and-forget head RPC."""
-        for raw in raw_ids:
-            self._local_drop(ObjectID(raw))
-            if raw in self.large_oids:
-                self._last_large_free = time.monotonic()
-            self.large_oids.discard(raw)
+        self._note_frees(raw_ids)
         if self._dataplane is not None:
             raw_ids = self._dataplane.intercept_frees(raw_ids)
             if not raw_ids:
@@ -1087,6 +1137,6 @@ class Client:
                 self._dataplane.close()
             except BaseException:  # noqa: BLE001
                 pass
-        for st in self._stores.values():
+        for st in self._stores_snapshot():
             st.close()
         self.rpc.close()

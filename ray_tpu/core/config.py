@@ -13,6 +13,60 @@ import os
 from typing import Any, Dict
 
 
+#: Spawn-env contract: every ``RT_*`` environment variable the package
+#: reads AD HOC (raw ``os.environ``, outside this module).  These are the
+#: process-boundary half of the config surface — the head exports them
+#: into node-daemon envs, node daemons into worker envs, operators into
+#: CLI/driver envs — and a key that drifts on one side fails silently
+#: (``environ.get`` defaults kick in).  rtlint RT009 reconciles this
+#: catalog against the package's actual reads and spawn-site exports
+#: (missing / stale / orphan-write, mirroring RT003's three-way shape).
+#: ``Config`` fields need no entry: ``RT_<FIELD>`` overrides resolve
+#: through ``_env_override`` below and ad-hoc reads of them are flagged.
+SPAWN_ENV_CONTRACT = {
+    # -- cluster topology (spawner -> child) ----------------------------------
+    "RT_ADDRESS": "head address for attach paths: CLI, autoscaler, "
+                  "job-submission drivers, Cluster.attach",
+    "RT_HEAD_ADDR": "head address exported to spawned node daemons and "
+                    "workers (their Client dials it at boot)",
+    "RT_NODE_ID": "pre-assigned node id for spawned daemons/workers; also "
+                  "read by train sessions for rank placement metadata",
+    "RT_SESSION": "store session namespace a worker's object writes land "
+                  "in (set by the node daemon / head spawner)",
+    "RT_NODE_SESSION": "store session a spawned node daemon serves "
+                       "(cluster_utils launches daemons with the cluster "
+                       "session; unset daemons mint their own)",
+    "RT_PEER_HOST": "host the worker's peer RPC server binds (defaults "
+                    "to loopback; multi-host spawners set the node IP)",
+    "RT_LOG_PATH": "log file the spawner redirected this process into; "
+                   "registered in the head's cluster log index",
+    "RT_NODE_RESOURCES": "JSON resource map for a spawned node daemon",
+    "RT_NODE_LABELS": "JSON label map for a spawned node daemon",
+    "RT_NODE_NUM_WORKERS": "worker-pool cap for a spawned node daemon",
+    "RT_NODE_HOST": "bind host for a spawned node daemon's servers",
+    "RT_DRAIN_GRACE_S": "SIGTERM drain grace window for node daemons "
+                        "(cluster_utils preemption uses the same knob)",
+    # -- driver/operator knobs ------------------------------------------------
+    "RT_NUM_TPUS": "TPU resource count override for init()",
+    "RT_TPU_ACCELERATOR_TYPE": "accelerator type override for init()",
+    "RT_TPU_CHIPS": "chip-inventory override for accelerator detection",
+    "RT_TPU_GCE_METADATA": "1 = allow GCE metadata-server TPU probes",
+    "RT_PRESTART_WORKERS": "cap on workers pre-started at init()",
+    "RT_LOG_TO_DRIVER": "0 = don't mirror worker stdout/stderr to the "
+                        "driver via pubsub",
+    "RT_FORCE_PROXY_DRIVER": "1 = force the off-host proxy driver path "
+                             "(tests; hosts without usable /dev/shm)",
+    # -- debug switches -------------------------------------------------------
+    "RT_DEBUG_PUSH": "worker-side push/exec tracing to stderr",
+    "RT_DEBUG_RPC_ERR": "server-side RPC handler error dumps to stderr",
+    "RT_DEBUG_LOCKS": "lock sentinel level: 1 = ordering checks, 2 = + "
+                      "guard-map race sentinel (devtools.locks)",
+    "RT_DEBUG_LOCKS_HOLD_S": "long-hold warning threshold for the lock "
+                             "sentinel",
+    "RT_NATIVE_SANITIZE": "build the _native helper with a sanitizer",
+}
+
+
 def _env_override(name: str, default):
     raw = os.environ.get(f"RT_{name.upper()}")
     if raw is None:

@@ -37,7 +37,7 @@ from .. import exceptions
 from . import serialization
 from .config import get_config
 from .rpc import RpcClient
-from ..devtools.locks import make_lock
+from ..devtools.locks import guarded, make_lock
 
 #: pipelining bound for an actor's peer connection: deep (the head path
 #: blocks at 1000 in-flight background RPCs, this is the analog), and calls
@@ -135,11 +135,34 @@ class _DirectCall:
         self.share = False
 
 
+@guarded
 class Dataplane:
     """Per-client routing state for both peer planes.  All public entry
     points are thread-safe; completion callbacks run on peer RPC loop
     threads and only ever take this object's lock plus the client's batch
     locks (strictly in that order)."""
+
+    # Every routing table below is mutated from submitter threads, the
+    # head-connection rpc loop (push handlers, lease replies), the shared
+    # peer loop (completion callbacks), and throwaway fallback threads.
+    # rtlint RT007 verifies the guards statically; RT_DEBUG_LOCKS=2
+    # asserts them on every field rebind at runtime (devtools.locks).
+    _RT_GUARDED_BY = {
+        "_routes": "_lock",
+        "_pools": "_lock",
+        "_calls": "_lock",
+        "_task_calls": "_lock",
+        "_stream_routes": "_lock",
+        "_results": "_lock",
+        "_registered": "_lock",
+        "_pins": "_lock",
+        "_deferred_frees": "_lock",
+        "_retired_conns": "_lock",
+        "_failed_sends": "_lock",
+        "_staged_callbacks": "_lock",
+        "_subscribed": "_lock",
+        "_peer_loop": "_peer_loop_lock",
+    }
 
     def __init__(self, client):
         cfg = get_config()
@@ -175,7 +198,7 @@ class Dataplane:
         # One shared loop thread multiplexes every peer connection (a
         # reader thread per worker connection would thrash small hosts).
         self._peer_loop = None
-        self._peer_loop_lock = threading.Lock()
+        self._peer_loop_lock = make_lock("dataplane.peer_loop")
         self._subscribed = False
         self._direct_counter = None
         self._leased_counter = None
@@ -210,13 +233,18 @@ class Dataplane:
     # ----------------------------------------------------------- plumbing
 
     def _ensure_subscribed(self):
-        if self._subscribed:
-            return
-        self._subscribed = True
+        # Flag flips under the lock (claim-then-act: one thread wins the
+        # subscribe); the RPC itself runs outside it — subscribe() blocks
+        # on the head round trip and must not hold the dataplane lock.
+        with self._lock:
+            if self._subscribed:
+                return
+            self._subscribed = True
         try:
             self._client.subscribe("actor_events", self._on_actor_event)
         except Exception:
-            self._subscribed = False
+            with self._lock:
+                self._subscribed = False
 
     def _get_peer_loop(self):
         import asyncio
@@ -862,12 +890,16 @@ class Dataplane:
         callbacks (inline-safe now — the lock is released) and re-route
         failed sends BEFORE anything queued behind them, preserving
         per-submitter order."""
-        if self._staged_callbacks:
+        # The two bare reads are deliberate double-checked pre-checks: the
+        # hot per-completion path must not pay a lock round trip when both
+        # lists are empty; a stale non-empty read just takes the lock and
+        # finds nothing, a stale empty read is flushed by the next caller.
+        if self._staged_callbacks:  # rt-unguarded: double-checked pre-check
             with self._lock:
                 cbs, self._staged_callbacks = self._staged_callbacks, []
             for fut, cb in cbs:
                 fut.add_done_callback(cb)
-        if self._failed_sends:
+        if self._failed_sends:  # rt-unguarded: double-checked pre-check
             self._flush_failed_sends()
 
     def _submit_via_head_offloop(self, calls: List[_DirectCall]):

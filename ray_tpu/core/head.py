@@ -33,7 +33,7 @@ from .config import Config
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .rpc import Connection, RpcServer
 from .scheduler import ClusterScheduler, SchedulingStrategy
-from ..devtools.locks import make_lock
+from ..devtools.locks import guarded, make_lock
 
 # Worker / actor / task states (subset of the reference FSMs:
 # gcs_actor_manager.h actor FSM, worker_pool.h worker states).
@@ -199,8 +199,18 @@ class ObjectRecord:
         self.spilled = False
 
 
+@guarded
 class Head:
     """The control-plane server."""
+
+    # Spawn bookkeeping is mutated off-loop (executor spawn threads) while
+    # the loop prunes/kills: rtlint RT007 verifies these statically and
+    # RT_DEBUG_LOCKS=2 asserts them at runtime (devtools.locks).
+    _RT_GUARDED_BY = {
+        "worker_pids": "_pids_lock",
+        "worker_procs": "_pids_lock",
+        "_zygote": "_zygote_mutex",
+    }
 
     def __init__(self, config: Config, session: str, host: str = "127.0.0.1"):
         self.config = config
@@ -249,6 +259,10 @@ class Head:
         self.worker_pids: List[int] = []  # zygote-forked (init reaps them)
         self._zygote = None
         self._zygote_mutex = make_lock("head.zygote")
+        # Guards worker_pids/worker_procs only (list ops, microseconds):
+        # spawns mutate them from executor threads while the loop prunes
+        # exited pids — never hold this across the zygote handshake.
+        self._pids_lock = make_lock("head.worker_pids")
         self.node_daemons: Dict[NodeID, Connection] = {}
         # Object-plane server address per node (chunked pull endpoint).
         self.node_object_addrs: Dict[NodeID, str] = {}
@@ -593,11 +607,15 @@ class Head:
                     pass
                 # Prune exited zygote-forked workers (orphans reaped by
                 # init) so shutdown never signals a recycled pid.
-                for pid in list(self.worker_pids):
+                with self._pids_lock:
+                    pids = list(self.worker_pids)
+                for pid in pids:
                     try:
                         os.kill(pid, 0)
                     except (ProcessLookupError, PermissionError):
-                        self.worker_pids.remove(pid)
+                        with self._pids_lock:
+                            if pid in self.worker_pids:
+                                self.worker_pids.remove(pid)
                 # Health probes: push to every worker; acks come back via
                 # h_health_ack.  A wedged process keeps the TCP connection
                 # open but its rpc loop stops acking.
@@ -834,16 +852,25 @@ class Head:
                 except Exception:
                     pass
         await asyncio.sleep(0.05)
-        for p in self.worker_procs:
+        with self._pids_lock:
+            procs = list(self.worker_procs)
+            pids = list(self.worker_pids)
+        for p in procs:
             if p.poll() is None:
                 p.terminate()
-        for pid in self.worker_pids:
+        for pid in pids:
             try:
                 os.kill(pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
-        if self._zygote is not None:
-            self._zygote.close()
+        # Off-loop: an in-flight spawn can hold the mutex across its whole
+        # handshake (seconds) and the loop must keep serving until then.
+        def _close_zygote():
+            with self._zygote_mutex:
+                if self._zygote is not None:
+                    self._zygote.close()
+
+        await asyncio.get_running_loop().run_in_executor(None, _close_zygote)
         if getattr(self, "_bulk_server", None) is not None:
             self._bulk_server.close()
         await self.server.stop()
@@ -869,13 +896,21 @@ class Head:
             self._bulk_server = None
         # Boot the local zygote eagerly: its one-time import cost overlaps
         # driver startup instead of delaying the first worker spawn.
-        if self._zygote is None:
+        # Try-acquire, never block: do_spawn holds the mutex across whole
+        # spawn handshakes on executor threads, and this runs on the loop —
+        # if it's contended, a spawn is already booting the zygote for us.
+        if self._zygote_mutex.acquire(blocking=False):
             try:
-                from .zygote import Zygote
+                if self._zygote is None:
+                    try:
+                        from .zygote import Zygote
 
-                self._zygote = Zygote(self._worker_base_env(node_id))
-            except Exception:
-                self._zygote = None
+                        self._zygote = Zygote(  # rt-unguarded: mutex IS held (try-acquired above; a with-block would stall the loop)
+                            self._worker_base_env(node_id))
+                    except Exception:
+                        self._zygote = None  # rt-unguarded: mutex IS held (try-acquired above)
+            finally:
+                self._zygote_mutex.release()
         return node_id
 
     def _worker_base_env(self, node_id: NodeID) -> Dict[str, str]:
@@ -902,12 +937,9 @@ class Head:
             RT_NODE_ID=node_id.hex(),
             RT_SESSION=self.node_sessions[node_id],
             # Peer-plane wiring: the host the worker's peer RPC server
-            # binds, and the node's object-plane endpoints (stamped into
-            # direct-call result descriptors so cross-node readers can pull
-            # without a directory lookup).
+            # binds.  (The node's object-plane endpoints travel via the
+            # register reply / resolve_actor descriptors, not env.)
             RT_PEER_HOST=self.host,
-            RT_OBJECT_ADDR=self.node_object_addrs.get(node_id, ""),
-            RT_BULK_ADDR=self.node_bulk_addrs.get(node_id, ""),
             # Workers default to CPU so they never grab the TPU from under the
             # driver; tasks that need the chip opt in via resources={"TPU": n}
             # + runtime_env (see worker_main._maybe_enable_tpu).
@@ -940,10 +972,11 @@ class Head:
                 self._zygote, pid, proc = spawn_with_fallback(
                     self._zygote, env, log_path
                 )
-                if pid is not None:
-                    self.worker_pids.append(pid)
-                else:
-                    self.worker_procs.append(proc)
+                with self._pids_lock:
+                    if pid is not None:
+                        self.worker_pids.append(pid)
+                    else:
+                        self.worker_procs.append(proc)
 
         asyncio.get_running_loop().run_in_executor(None, do_spawn)
 
@@ -1069,10 +1102,12 @@ class Head:
             w = self.workers.get(worker_id)
             if w is not None:
                 self._retire_metrics(w.pid)
-            if w is not None and w.pid in self.worker_pids:
+            if w is not None:
                 # Exited zygote-forked worker: drop the pid now so a later
                 # shutdown can't signal a recycled pid.
-                self.worker_pids.remove(w.pid)
+                with self._pids_lock:
+                    if w.pid in self.worker_pids:
+                        self.worker_pids.remove(w.pid)
             await self._handle_worker_death(worker_id)
         node_id = conn.meta.get("node_id")
         if node_id is not None and conn.meta.get("kind") == "node":

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..devtools.locks import guarded, make_lock
 from .config import get_config
 from .ids import NodeID, ObjectID
 from .object_store import ObjectStore
@@ -249,7 +250,25 @@ def make_pull_handler(store: ObjectStore):
     return h_pull_object
 
 
+@guarded
 class NodeDaemon:
+    # Worker bookkeeping is shared between the spawner thread, push
+    # handlers on the head-connection rpc loop, and the main daemon loop:
+    # rtlint RT007 verifies the guards statically, RT_DEBUG_LOCKS=2
+    # asserts them at runtime.  head/node_id are write-once publications:
+    # set before (or guarded against) any handler that reads them can run.
+    _RT_GUARDED_BY = {
+        "worker_pids": "_workers_lock",
+        "worker_procs": "_workers_lock",
+        "zygote": "_zygote_lock",
+    }
+    _RT_UNGUARDED = {
+        "head": "write-once in start() before any push handler is "
+                "registered on it; handlers only run after registration",
+        "node_id": "write-once after register(); the health-check lambda "
+                   "guards the pre-registration None window",
+    }
+
     def __init__(self):
         cfg = get_config()
         self.head_addr = os.environ["RT_HEAD_ADDR"]
@@ -281,6 +300,13 @@ class NodeDaemon:
         self.worker_procs: List[subprocess.Popen] = []
         self.worker_pids: set = set()  # zygote-forked (orphaned to init)
         self.zygote = None
+        # worker_pids/worker_procs are touched from the spawner thread,
+        # the rpc-loop push handlers (_on_kill_worker), and the main loop;
+        # the zygote is swapped by start() and the spawner.  Cheap lock for
+        # the former (list/set ops only); the zygote lock may be held for
+        # a whole spawn handshake, so never take it on the rpc loop.
+        self._workers_lock = make_lock("node.workers")
+        self._zygote_lock = make_lock("node.zygote")
         from concurrent.futures import ThreadPoolExecutor
 
         self._spawn_exec = ThreadPoolExecutor(1, thread_name_prefix="spawner")
@@ -332,14 +358,19 @@ class NodeDaemon:
             body["node_id"] = bytes.fromhex(os.environ["RT_NODE_ID"])
         reply = self.head.call("register", body)
         self.node_id = NodeID(reply["node_id"])
-        # Boot the zygote eagerly so the first spawn request doesn't pay the
-        # forkserver's one-time import cost.
-        try:
-            from .zygote import Zygote
+        # Boot the zygote eagerly so the first spawn request doesn't pay
+        # the forkserver's one-time import cost.  Under the lock: a
+        # spawn_worker push can arrive the moment register() returns, and
+        # the spawner thread swaps self.zygote too — an unsynchronized
+        # last-write-wins here would leak a live forkserver process.
+        with self._zygote_lock:
+            if self.zygote is None:
+                try:
+                    from .zygote import Zygote
 
-            self.zygote = Zygote(self._worker_env())
-        except Exception:
-            self.zygote = None
+                    self.zygote = Zygote(self._worker_env())
+                except Exception:
+                    self.zygote = None
 
     @staticmethod
     def _split(addr: str):
@@ -366,12 +397,9 @@ class NodeDaemon:
             RT_NODE_ID=self.node_id.hex(),
             RT_SESSION=self.session,
             # Peer-plane wiring: workers bind their peer RPC server on this
-            # node's host and stamp the node's object-plane endpoints into
-            # direct-call result descriptors (cross-node readers pull
-            # straight from here, no directory lookup).
+            # node's host.  (The node's object-plane endpoints travel via
+            # the register body and head-side descriptors, not env.)
             RT_PEER_HOST=self.host,
-            RT_OBJECT_ADDR=f"{self.host}:{self.server.port}",
-            RT_BULK_ADDR=f"{self.host}:{self.bulk_server.port}",
             JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
         )
         return env
@@ -388,13 +416,15 @@ class NodeDaemon:
         log_dir = os.path.join(LOG_ROOT, self.session)
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
-        self.zygote, pid, proc = spawn_with_fallback(
-            self.zygote, env, log_path
-        )
-        if pid is not None:
-            self.worker_pids.add(pid)
-        else:
-            self.worker_procs.append(proc)
+        with self._zygote_lock:
+            self.zygote, pid, proc = spawn_with_fallback(
+                self.zygote, env, log_path
+            )
+        with self._workers_lock:
+            if pid is not None:
+                self.worker_pids.add(pid)
+            else:
+                self.worker_procs.append(proc)
 
     def _on_kill_worker(self, body):
         """SIGKILL a wedged local worker on the head's behalf — a stopped
@@ -402,8 +432,11 @@ class NodeDaemon:
         spawned it) must deliver the signal (reference: raylet DestroyWorker
         kills local worker processes)."""
         pid = body.get("pid")
-        if pid and (pid in self.worker_pids
-                    or any(p.pid == pid for p in self.worker_procs)):
+        with self._workers_lock:
+            ours = bool(pid) and (
+                pid in self.worker_pids
+                or any(p.pid == pid for p in self.worker_procs))
+        if ours:
             try:
                 os.kill(pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
@@ -444,9 +477,10 @@ class NodeDaemon:
         # just a short linger so the announce RPC flushes (the early-exit
         # check in run() uses this floor).
         self._prune_worker_pids()
-        had_workers = bool(self.worker_pids) or any(
-            p.poll() is None for p in self.worker_procs
-        )
+        with self._workers_lock:
+            had_workers = bool(self.worker_pids) or any(
+                p.poll() is None for p in self.worker_procs
+            )
         self._drain_min_wait = 1.0 if had_workers else 0.3
         try:
             self.head.call_async("node_drain", {
@@ -462,13 +496,15 @@ class NodeDaemon:
         """Drop zygote-forked worker pids whose process is gone (orphans
         reaped by init): a stale pid could be recycled by an unrelated
         process and must never be signalled at shutdown."""
-        for pid in list(self.worker_pids):
+        with self._workers_lock:
+            pids = list(self.worker_pids)
+        for pid in pids:
             try:
                 os.kill(pid, 0)
-            except ProcessLookupError:
-                self.worker_pids.discard(pid)
-            except PermissionError:
-                self.worker_pids.discard(pid)  # recycled: not ours
+            except (ProcessLookupError, PermissionError):
+                # Gone (or recycled by an unrelated uid): not ours anymore.
+                with self._workers_lock:
+                    self.worker_pids.discard(pid)
 
     def _report_stats(self):
         """Push this node's resource view to the head: store pressure, host
@@ -488,7 +524,7 @@ class NodeDaemon:
             "load1": load1,
             "mem_used_frac": host_memory_used_frac(),
             "num_worker_procs": (
-                len(self.worker_pids) + len(self.worker_procs)
+                len(self.worker_pids) + len(self.worker_procs)  # rt-unguarded: len() snapshot for best-effort stats
             ),
         }
         try:
@@ -509,31 +545,39 @@ class NodeDaemon:
                 # at drain, so an idle node clears out in ~a second while a
                 # gang-hosting node runs its full window).
                 self._prune_worker_pids()
-                live_procs = [p for p in self.worker_procs
-                              if p.poll() is None]
-                if (not self.worker_pids and not live_procs
+                with self._workers_lock:
+                    live_procs = [p for p in self.worker_procs
+                                  if p.poll() is None]
+                    no_workers = not self.worker_pids and not live_procs
+                if (no_workers
                         and time.monotonic() >=
                         self._drain_deadline - self.drain_grace_s
                         + self._drain_min_wait):
                     break
             self.store.tick()  # cooled freed segments -> warm pool
             # Reap exited worker processes so they don't zombie.
-            for p in self.worker_procs:
+            with self._workers_lock:
+                procs = list(self.worker_procs)
+            for p in procs:
                 p.poll()
             ticks += 1
             if ticks % 10 == 0:
                 self._report_stats()
                 self._prune_worker_pids()
-        for p in self.worker_procs:
+        with self._workers_lock:
+            procs = list(self.worker_procs)
+            pids = list(self.worker_pids)
+        for p in procs:
             if p.poll() is None:
                 p.terminate()
-        for pid in self.worker_pids:
+        for pid in pids:
             try:
                 os.kill(pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
-        if self.zygote is not None:
-            self.zygote.close()
+        with self._zygote_lock:
+            if self.zygote is not None:
+                self.zygote.close()
         # Sweep this node's session-scoped fn-table blob cache (workers
         # populate /tmp/ray_tpu_fncache/<session>; the head's sweep only
         # covers its own host's filesystem).

@@ -20,7 +20,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
-from ..devtools.locks import make_lock
+from ..devtools.locks import guarded, make_lock
 
 REQ, RESP, ERR, PUSH = 0, 1, 2, 3
 _HDR = struct.Struct("<I")
@@ -117,6 +117,12 @@ class RpcServer:
     """Asyncio RPC server.  Handlers are ``async def handler(conn, body)`` or
     plain callables; return value becomes the response body."""
 
+    _RT_UNGUARDED = {
+        "handlers": "registered at server construction, before start() "
+                    "opens the listening socket — no request can race the "
+                    "registration",
+    }
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port
@@ -201,11 +207,32 @@ class RpcServer:
                 pass
 
 
+@guarded
 class RpcClient:
     """Thread-safe synchronous client over a background asyncio loop.
 
     Push handlers run on the loop; long handlers must hand off to a thread.
     """
+
+    # rtlint RT007 verifies the outbox guards statically; RT_DEBUG_LOCKS=2
+    # asserts them at runtime (devtools.locks).
+    _RT_GUARDED_BY = {
+        "_seq": "_seq_lock",
+        "_outbox": "_seq_lock",
+        "_outbox_scheduled": "_seq_lock",
+    }
+    _RT_UNGUARDED = {
+        "closed": "monotonic bool flip: every writer stores True; readers "
+                  "that lose the race fail into ConnectionLost anyway",
+        "_push_handlers": "handlers are registered at client setup before "
+                          "their method's pushes can arrive; dict get/set "
+                          "are GIL-atomic",
+        "on_connection_lost": "voluntary close() stores None so the "
+                              "lost-connection callback is suppressed; a "
+                              "racing read in the reader's teardown just "
+                              "runs the old callback once, which close() "
+                              "tolerates",
+    }
 
     def __init__(self, host: str, port: int, name: str = "rpc-client",
                  connect_timeout_s: Optional[float] = None,

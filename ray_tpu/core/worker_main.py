@@ -33,7 +33,7 @@ import cloudpickle
 
 from .. import exceptions
 from . import serialization
-from ..devtools.locks import make_lock
+from ..devtools.locks import guarded, make_lock
 from .client import Client
 from .config import get_config
 from .context import ctx
@@ -43,10 +43,20 @@ from .object_ref import ObjectRef, _TopLevelRef
 _DEBUG_PUSH = bool(os.environ.get("RT_DEBUG_PUSH"))
 
 
+@guarded
 class _LogTee:
     """Mirrors a worker stream to the driver via pubsub (reference:
     _private/log_monitor.py tails worker logs and republishes to the driver
     over GCS pubsub; here the worker pushes lines itself)."""
+
+    # print() runs on every task thread concurrently: the line buffer AND
+    # the in-flight publish window are shared state (rtlint RT007;
+    # RT_DEBUG_LOCKS=2 asserts the guards at runtime).
+    _RT_GUARDED_BY = {
+        "_buf": "_buf_lock",
+        "_inflight": "_buf_lock",
+        "dropped": "_buf_lock",
+    }
 
     def __init__(self, stream, client, kind: str):
         self._stream = stream
@@ -76,12 +86,17 @@ class _LogTee:
         for line in lines:
             self._local.publishing = True
             try:
-                self._inflight = [f for f in self._inflight if not f.done()]
-                if len(self._inflight) >= 200:
+                with self._buf_lock:
+                    self._inflight = [
+                        f for f in self._inflight if not f.done()
+                    ]
+                    drop = len(self._inflight) >= 200
+                    if drop:
+                        self.dropped += 1
+                if drop:
                     # Head is behind: drop rather than block — but visibly
                     # (the drop count ships with the process metrics, so a
                     # chatty worker outrunning the head is diagnosable).
-                    self.dropped += 1
                     try:
                         if self._drop_counter is None:
                             from ray_tpu.util.metrics import get_counter
@@ -97,7 +112,7 @@ class _LogTee:
                     except Exception:
                         pass
                     continue
-                self._inflight.append(self._client.rpc.call_async(
+                fut = self._client.rpc.call_async(
                     "publish", {
                         "topic": "worker_logs",
                         "data": {"pid": os.getpid(), "stream": self._kind,
@@ -105,7 +120,9 @@ class _LogTee:
                                  if ctx.current_actor_id else None,
                                  "line": line},
                     }
-                ))
+                )
+                with self._buf_lock:
+                    self._inflight.append(fut)
             except Exception:
                 pass
             finally:
@@ -141,7 +158,41 @@ class _LogTee:
         return getattr(self._stream, name)
 
 
+@guarded
 class Worker:
+    # rtlint RT007 verifies these statically; RT_DEBUG_LOCKS=2 asserts the
+    # guards on field rebinds at runtime (devtools.locks).
+    _RT_GUARDED_BY = {
+        "direct_streams": "_streams_lock",
+        "_direct_replies": "_direct_replies_lock",
+        "_direct_replies_scheduled": "_direct_replies_lock",
+    }
+    # Intentional cross-thread handoffs, vetted per CONTRIBUTING's
+    # thread-role model: each is either ordered by the task queue (the
+    # actor-creation task strictly precedes any concurrently-dispatched
+    # method call) or a GIL-atomic monotonic best-effort signal.
+    _RT_UNGUARDED = {
+        "fn_cache": "content-addressed idempotent cache: a racing double "
+                    "load stores the same value twice",
+        "running_threads": "GIL-atomic dict set/pop keyed by task_id; "
+                           "readers (cancel, stack dump) are best-effort",
+        "cancelled": "GIL-atomic monotonic set.add; a cancel losing the "
+                     "race is indistinguishable from arriving late",
+        "actor_instance": "written by the actor-creation task, which the "
+                          "task queue orders before any method dispatch",
+        "actor_id": "creation-ordered (see actor_instance); the peer "
+                    "server treats a mid-boot None as a stale route",
+        "max_concurrency": "creation-ordered (see actor_instance)",
+        "out_of_order": "creation-ordered (see actor_instance)",
+        "method_groups": "creation-ordered (see actor_instance)",
+        "_group_limits": "creation-ordered (see actor_instance)",
+        "group_pools": "creation-ordered (see actor_instance)",
+        "async_loop": "only the run-loop thread dispatches async methods, "
+                      "so the lazy loop boot never races itself",
+        "_async_group_sems": "dispatched from the run-loop thread only "
+                             "(see async_loop)",
+    }
+
     def __init__(self):
         from .node_main import own_log_path
         from .rpc import RpcServer, ServerThread
@@ -157,6 +208,10 @@ class Worker:
         # worker record; zygote-forked workers therefore come up with a
         # live peer endpoint before their first lease/call.
         self.direct_streams: Dict[bytes, dict] = {}
+        # Stream state is shared between the peer-server loop (submit /
+        # item pulls) and the executing task's thread (item appends,
+        # completion marks): every direct_streams access holds this.
+        self._streams_lock = make_lock("worker.streams")
         peer_host = os.environ.get("RT_PEER_HOST", "127.0.0.1")
         self.peer_server = RpcServer(host=peer_host)
         self.peer_server.register("peer_submit", self.h_peer_submit)
@@ -569,11 +624,12 @@ class Worker:
             body["retryable"] = retryable
             body["error_repr"] = error_repr
             body["error_tb"] = error_tb
-        st = self.direct_streams.get(spec["task_id"])
-        if st is not None:
-            st["done"] = stream_count
-            if error is not None:
-                st["error"] = error
+        with self._streams_lock:
+            st = self.direct_streams.get(spec["task_id"])
+            if st is not None:
+                st["done"] = stream_count
+                if error is not None:
+                    st["error"] = error
 
         with self._direct_replies_lock:
             self._direct_replies.append((fut, body))
@@ -656,17 +712,18 @@ class Worker:
         fut = loop.create_future()
         spec["_direct_reply"] = (loop, fut)
         if spec.get("num_returns") == "streaming":
-            if len(self.direct_streams) > 256:
-                # Bound retained stream state: shed fully-reported streams
-                # whose consumer never drained to the end.
-                for tid in list(self.direct_streams):
-                    if self.direct_streams[tid]["done"] is not None:
-                        del self.direct_streams[tid]
-                    if len(self.direct_streams) <= 256:
-                        break
-            self.direct_streams[spec["task_id"]] = {
-                "items": [], "done": None, "error": None,
-            }
+            with self._streams_lock:
+                if len(self.direct_streams) > 256:
+                    # Bound retained stream state: shed fully-reported
+                    # streams whose consumer never drained to the end.
+                    for tid in list(self.direct_streams):
+                        if self.direct_streams[tid]["done"] is not None:
+                            del self.direct_streams[tid]
+                        if len(self.direct_streams) <= 256:
+                            break
+                self.direct_streams[spec["task_id"]] = {
+                    "items": [], "done": None, "error": None,
+                }
         self.task_queue.put(spec)
         return await fut
 
@@ -680,17 +737,19 @@ class Worker:
         task_id = body["task_id"]
         index = int(body["index"])
         while True:
-            st = self.direct_streams.get(task_id)
-            if st is None:
-                return {"done": True}
-            if index < len(st["items"]):
-                return {"item": st["items"][index]}
-            if st["error"] is not None:
-                return {"error": st["error"]}
-            if st["done"] is not None:
-                # Fully consumed: drop the retained stream state.
-                self.direct_streams.pop(task_id, None)
-                return {"done": True}
+            # Brief hold per poll; released before the await (RT002).
+            with self._streams_lock:
+                st = self.direct_streams.get(task_id)
+                if st is None:
+                    return {"done": True}
+                if index < len(st["items"]):
+                    return {"item": st["items"][index]}
+                if st["error"] is not None:
+                    return {"error": st["error"]}
+                if st["done"] is not None:
+                    # Fully consumed: drop the retained stream state.
+                    self.direct_streams.pop(task_id, None)
+                    return {"done": True}
             await asyncio.sleep(0.005)
 
     async def h_peer_cancel(self, conn, body):
@@ -848,9 +907,10 @@ class Worker:
                         # Peer-submitted stream: items stay here and the
                         # submitter pulls them via peer_next_stream_item —
                         # no per-item head traffic.
-                        st = self.direct_streams.get(task_id)
-                        if st is not None:
-                            st["items"].append(info)
+                        with self._streams_lock:
+                            st = self.direct_streams.get(task_id)
+                            if st is not None:
+                                st["items"].append(info)
                     else:
                         self.client.call_bg(
                             "stream_item",

@@ -15,6 +15,18 @@ in CI for this).  ``core/`` creates its locks through :func:`make_lock` /
   same-instance re-entry on non-reentrant locks, and logs any lock held
   longer than ``RT_DEBUG_LOCKS_HOLD_S`` (default 1.0s — a held lock that
   long under a 0.2s control-plane tick is a stall in waiting).
+- **race sentinel** (``RT_DEBUG_LOCKS=2``, implies level 1): classes
+  decorated with :func:`guarded` enforce their declared guard map at
+  runtime — every rebind of a field listed in ``_RT_GUARDED_BY`` asserts
+  the named lock is held by the writing thread, else
+  :class:`GuardViolation` names the class, field, guard, and thread.
+  The same maps are what rtlint RT007 verifies statically; this is the
+  dynamic twin (the role TSAN + ``GUARDED_BY`` annotations play in the
+  C++ reference), soaked by ``scripts/chaos_soak.sh`` under
+  ``RT_DEBUG_LOCKS=2``.  ``__init__`` is exempt (the object is not yet
+  published); container mutation without a rebind is invisible to
+  ``__setattr__`` — the swap idiom (``x, self._x = self._x, []``) the
+  hot paths use is exactly what gets checked.
 
 Ordering is tracked between lock *names* (one name per call site /
 role, e.g. ``client.put_batch``), not instances: every ``Client`` has its
@@ -43,8 +55,26 @@ class LockOrderError(RuntimeError):
     deadlock waiting for the right thread interleaving."""
 
 
+class GuardViolation(RuntimeError):
+    """A field declared guarded (``_RT_GUARDED_BY``) was rebound by a
+    thread that does not hold its guard lock — a data race, caught at the
+    racing write instead of at the corrupted read."""
+
+
+def level() -> int:
+    """Sentinel level: 0 off, 1 ordering checks, 2 + guard-map races."""
+    raw = os.environ.get(ENV_FLAG, "")
+    if raw in ("1", "2"):
+        return int(raw)
+    return 0
+
+
 def enabled() -> bool:
-    return os.environ.get(ENV_FLAG, "") == "1"
+    return level() >= 1
+
+
+def race_sentinel_enabled() -> bool:
+    return level() >= 2
 
 
 def _hold_threshold() -> float:
@@ -204,6 +234,9 @@ class SentinelLock:
         locked = getattr(self._lock, "locked", None)
         return locked() if locked is not None else False
 
+    def held_by_current_thread(self) -> bool:
+        return any(other is self for other, _ in _held_stack())
+
     def __enter__(self):
         self.acquire()
         return self
@@ -228,3 +261,49 @@ def make_rlock(name: str):
     if not enabled():
         return threading.RLock()
     return SentinelLock(name, reentrant=True)
+
+
+# -- guard-map race sentinel (RT_DEBUG_LOCKS=2) --------------------------------
+
+
+def guarded(cls):
+    """Class decorator enforcing the class's declared guard map at runtime.
+
+    The map is the class attribute ``_RT_GUARDED_BY = {"field":
+    "_lock_attr", ...}`` — the same declaration rtlint RT007 verifies
+    statically.  Under ``RT_DEBUG_LOCKS=2`` every attribute REBIND of a
+    listed field asserts the instance's guard lock is held by the current
+    thread (``__init__`` exempt: the object is unpublished while it
+    constructs).  Any other level returns the class untouched — the
+    disabled path adds zero wrappers, zero per-write cost.
+    """
+    guards = getattr(cls, "_RT_GUARDED_BY", None)
+    if not race_sentinel_enabled() or not guards:
+        return cls
+
+    orig_init = cls.__init__
+    orig_setattr = cls.__setattr__
+
+    def __init__(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        object.__setattr__(self, "_rt_guards_armed", True)
+
+    def __setattr__(self, name, value):
+        lock_attr = guards.get(name)
+        if lock_attr is not None \
+                and getattr(self, "_rt_guards_armed", False):
+            lock = getattr(self, lock_attr, None)
+            if isinstance(lock, SentinelLock) \
+                    and not lock.held_by_current_thread():
+                raise GuardViolation(
+                    f"guarded field {cls.__name__}.{name} rebound by "
+                    f"thread {threading.current_thread().name!r} without "
+                    f"holding its guard {lock.name!r} ({lock_attr}) — "
+                    f"declared in {cls.__name__}._RT_GUARDED_BY; racing "
+                    f"write at {_site()}"
+                )
+        orig_setattr(self, name, value)
+
+    cls.__init__ = __init__
+    cls.__setattr__ = __setattr__
+    return cls

@@ -23,6 +23,17 @@ RT005   ``threading.Thread`` started without ``daemon=True`` or a visible
         join path — leaks non-daemon threads that hang interpreter exit
 RT006   ``ray_tpu_*`` metric emitted but missing from (or conflicting
         with) the ``BUILTIN_METRICS`` catalog in ``util/metrics.py``
+RT007   guarded-by race over ``core/``: a ``self.<attr>`` written from two
+        or more inferred thread roles (loop / rpc callbacks / executors /
+        named threads / main) with no lock in common across access paths;
+        also verifies declared ``_RT_GUARDED_BY`` maps (the runtime race
+        sentinel enforces the same maps under ``RT_DEBUG_LOCKS=2``)
+RT008   static lock-order cycle: nested ``with lock:`` scopes composed
+        through the call graph nest in both orders — a deadlock the test
+        suite merely never interleaved
+RT009   spawn-env contract drift: ad-hoc ``RT_*`` ``os.environ`` reads vs
+        the ``SPAWN_ENV_CONTRACT`` catalog in ``core/config.py``
+        (missing/stale/orphan-write, plus reads shadowing Config fields)
 ======  =====================================================================
 
 Vetted exceptions live in ``ray_tpu/.rtlint-allowlist`` (shipped as
@@ -52,13 +63,21 @@ class Finding:
     path: str  # posix path relative to the package parent (repo-relative)
     line: int
     message: str
+    #: structured context for --json consumers (dashboard lint view,
+    #: future tooling): RT007 carries the inferred thread roles and guard
+    #: locks behind the race, RT008 the lock cycle and its edge sites,
+    #: RT009 the env key and drift kind — the WHY, not just the where.
+    meta: Optional[dict] = None
 
     def key(self) -> tuple:
         return (self.rule, self.path, self.line, self.message)
 
     def as_dict(self) -> dict:
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "message": self.message}
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "message": self.message}
+        if self.meta is not None:
+            out["meta"] = self.meta
+        return out
 
 
 class Module:
@@ -192,8 +211,8 @@ def apply_allowlist(
 
 
 def all_rules():
-    from . import (rules_api, rules_async, rules_metrics, rules_rpc,
-                   rules_threads)
+    from . import (rules_api, rules_async, rules_concurrency, rules_config,
+                   rules_metrics, rules_rpc, rules_threads)
 
     return [
         rules_async.check_rt001,
@@ -202,6 +221,9 @@ def all_rules():
         rules_api.check_rt004,
         rules_threads.check_rt005,
         rules_metrics.check_rt006,
+        rules_concurrency.check_rt007,
+        rules_concurrency.check_rt008,
+        rules_config.check_rt009,
     ]
 
 
@@ -258,7 +280,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="ray_tpu lint",
-        description="framework-aware static analysis (rules RT001-RT006)",
+        description="framework-aware static analysis (rules RT001-RT009)",
     )
     ap.add_argument("--root", default=None,
                     help="package directory to lint (default: the "

@@ -7,13 +7,25 @@
 #
 # Usage:
 #   scripts/chaos_soak.sh [N]          # default N=5
+#   scripts/chaos_soak.sh --race-sentinel [N]
 #   CHAOS_PYTEST_ARGS="-k drain" scripts/chaos_soak.sh 10
 #
 # Rotating seeds: each iteration exports RT_CHAOS_SEED=<iter>, which the
 # chaos tests feed to their PreemptionInjector / victim RNGs, so every
 # pass kills a different node/worker mix.
+#
+# --race-sentinel (or RT_DEBUG_LOCKS=2 in the environment) soaks with the
+# devtools.locks runtime race sentinel armed in EVERY process: lock
+# ordering is checked transitively and each guarded dataplane field
+# rebind asserts its _RT_GUARDED_BY lock is held — so the SIGTERM chaos
+# interleavings double as a data-race hunt, not just a recovery test.
 set -u -o pipefail
 
+LOCKS_LEVEL="${RT_DEBUG_LOCKS:-0}"
+if [ "${1:-}" = "--race-sentinel" ]; then
+    LOCKS_LEVEL=2
+    shift
+fi
 N="${1:-5}"
 cd "$(dirname "$0")/.."
 
@@ -21,6 +33,7 @@ fails=0
 for i in $(seq 1 "$N"); do
     echo "=== chaos soak iteration $i/$N (RT_CHAOS_SEED=$i) ==="
     if ! env JAX_PLATFORMS=cpu RT_CHAOS_SEED="$i" \
+        RT_DEBUG_LOCKS="$LOCKS_LEVEL" \
         timeout -k 10 600 python -m pytest -q \
         -m chaos tests/test_fault_tolerance.py tests/test_chaos.py \
         -p no:cacheprovider -p no:randomly \

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # rtlint gate: framework-aware static analysis over the ray_tpu package
-# (rules RT001-RT006; engine in ray_tpu/devtools/rtlint.py, vetted
+# (rules RT001-RT009, including the RT007/RT008 concurrency analysis and
+# RT009 spawn-env contract; engine in ray_tpu/devtools/rtlint.py, vetted
 # exceptions in .rtlint-allowlist).  Non-zero exit on any unallowlisted
 # finding — scripts/verify.sh runs this before pytest so drift never
 # reaches the test stage.

@@ -12,7 +12,8 @@ import threading
 import pytest
 
 from ray_tpu.devtools import locks
-from ray_tpu.devtools.locks import (LockOrderError, SentinelLock, make_lock,
+from ray_tpu.devtools.locks import (GuardViolation, LockOrderError,
+                                    SentinelLock, guarded, make_lock,
                                     make_rlock, reset_sentinel_state)
 
 
@@ -22,6 +23,31 @@ def sentinel_on(monkeypatch):
     reset_sentinel_state()
     yield
     reset_sentinel_state()
+
+
+@pytest.fixture
+def race_sentinel_on(monkeypatch):
+    monkeypatch.setenv("RT_DEBUG_LOCKS", "2")
+    reset_sentinel_state()
+    yield
+    reset_sentinel_state()
+
+
+def _demo_class():
+    """Defined inside the fixture window: @guarded reads the env at class
+    decoration time, mirroring core/'s import-time wiring."""
+
+    @guarded
+    class Demo:
+        _RT_GUARDED_BY = {"_state": "_lock", "_count": "_lock"}
+
+        def __init__(self):
+            self._lock = make_lock("demo.state")
+            self._state = []   # init writes are exempt (unpublished)
+            self._count = 0
+            self.free = None   # undeclared: never checked
+
+    return Demo
 
 
 class TestDisabledPath:
@@ -200,6 +226,88 @@ class TestWrapperProtocol:
             assert lk.locked()
 
 
+class TestRaceSentinel:
+    """RT_DEBUG_LOCKS=2: guard-map-driven field-write assertions — the
+    runtime twin of rtlint RT007's declared-map verification."""
+
+    def test_unguarded_rebind_raises_naming_field_and_guard(
+            self, race_sentinel_on):
+        obj = _demo_class()()
+        with pytest.raises(GuardViolation) as ei:
+            obj._state = [1]
+        msg = str(ei.value)
+        assert "Demo._state" in msg
+        assert "demo.state" in msg  # the guard lock's name
+        assert threading.current_thread().name in msg
+
+    def test_guarded_rebind_passes(self, race_sentinel_on):
+        obj = _demo_class()()
+        with obj._lock:
+            obj._state = [1]
+            obj._count += 1
+        assert obj._state == [1] and obj._count == 1
+
+    def test_init_writes_exempt(self, race_sentinel_on):
+        # Construction writes every declared field with no lock held and
+        # must not trip — the object is unpublished until __init__ returns.
+        obj = _demo_class()()
+        assert obj._state == []
+
+    def test_undeclared_fields_unchecked(self, race_sentinel_on):
+        obj = _demo_class()()
+        obj.free = 42  # not in the guard map: plain setattr
+
+    def test_wrong_thread_with_lock_elsewhere_raises(self, race_sentinel_on):
+        # The guard must be held BY THE WRITING THREAD, not merely locked.
+        obj = _demo_class()()
+        obj._lock.acquire()
+        errors = []
+
+        def write():
+            try:
+                obj._state = [2]
+            except GuardViolation as e:
+                errors.append(e)
+
+        t = threading.Thread(target=write)
+        t.start()
+        t.join()
+        obj._lock.release()
+        assert len(errors) == 1
+
+    def test_level2_implies_ordering_sentinel(self, race_sentinel_on):
+        a, b = make_lock("A2"), make_lock("B2")
+        assert isinstance(a, SentinelLock)
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderError, match="inversion"):
+                a.acquire()
+
+    def test_disabled_path_zero_overhead(self, monkeypatch):
+        # Off (and at level 1): @guarded must hand back the SAME class —
+        # no wrapped __setattr__, no per-write cost, no armed marker.
+        for value in (None, "0", "1"):
+            if value is None:
+                monkeypatch.delenv("RT_DEBUG_LOCKS", raising=False)
+            else:
+                monkeypatch.setenv("RT_DEBUG_LOCKS", value)
+
+            class Plain:
+                _RT_GUARDED_BY = {"_x": "_lock"}
+
+                def __init__(self):
+                    self._lock = make_lock("plain")
+                    self._x = 0
+
+            decorated = guarded(Plain)
+            assert decorated is Plain
+            obj = decorated()
+            obj._x = 1  # no lock held: must not raise
+            assert not hasattr(obj, "_rt_guards_armed")
+
+
 class TestCoreIntegration:
     def test_core_locks_are_sentinels_when_enabled(self):
         # core/ builds its locks through make_lock: under RT_DEBUG_LOCKS=1
@@ -224,3 +332,60 @@ class TestCoreIntegration:
         )
         assert out.returncode == 0, out.stdout + out.stderr
         assert "sentinel-ok" in out.stdout
+
+    def test_core_guard_maps_enforced_when_enabled(self):
+        # Under RT_DEBUG_LOCKS=2 the dataplane-facing core classes come up
+        # instrumented: a guarded field rebound without its lock raises in
+        # a fresh interpreter.  _LogTee is the cheapest such class to
+        # construct standalone; the same decorator wires Dataplane,
+        # RpcClient, Worker, Client, Head, and NodeDaemon.
+        import os
+        import subprocess
+        import sys
+
+        code = (
+            "import io\n"
+            "from ray_tpu.core.worker_main import _LogTee\n"
+            "from ray_tpu.core.rpc import RpcClient\n"
+            "from ray_tpu.core.dataplane import Dataplane\n"
+            "from ray_tpu.devtools.locks import GuardViolation\n"
+            "t = _LogTee(io.StringIO(), None, 'stdout')\n"
+            "with t._buf_lock:\n"
+            "    t._buf = 'guarded write ok'\n"
+            "try:\n"
+            "    t._buf = 'unguarded'\n"
+            "    raise SystemExit('no violation raised')\n"
+            "except GuardViolation as e:\n"
+            "    assert '_LogTee._buf' in str(e), e\n"
+            "for cls in (RpcClient, Dataplane):\n"
+            "    assert cls.__setattr__ is not object.__setattr__, cls\n"
+            "print('race-sentinel-ok')\n"
+        )
+        env = dict(os.environ, RT_DEBUG_LOCKS="2", JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "race-sentinel-ok" in out.stdout
+
+    def test_core_classes_untouched_when_disabled(self):
+        import os
+        import subprocess
+        import sys
+
+        code = (
+            "from ray_tpu.core.dataplane import Dataplane\n"
+            "from ray_tpu.core.rpc import RpcClient\n"
+            "for cls in (Dataplane, RpcClient):\n"
+            "    assert cls.__setattr__ is object.__setattr__, cls\n"
+            "print('plain-ok')\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("RT_DEBUG_LOCKS", None)
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "plain-ok" in out.stdout

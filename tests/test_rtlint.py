@@ -12,9 +12,9 @@ import sys
 import textwrap
 from pathlib import Path
 
-from ray_tpu.devtools import rules_api, rules_async, rules_metrics, \
-    rules_rpc, rules_threads
-from ray_tpu.devtools.rtlint import (Project, default_allowlist,
+from ray_tpu.devtools import rules_api, rules_async, rules_concurrency, \
+    rules_config, rules_metrics, rules_rpc, rules_threads
+from ray_tpu.devtools.rtlint import (Project, all_rules, default_allowlist,
                                      default_package_root, load_allowlist,
                                      run_lint)
 
@@ -326,6 +326,346 @@ class TestRT006:
         assert findings(root, rules_metrics.check_rt006) == []
 
 
+# -- RT007: thread-role inference + guarded-by races ---------------------------
+
+
+class TestRT007:
+    def test_cross_role_unguarded_write_flagged(self, tmp_path):
+        # A field written by a dedicated thread AND by public (main-role)
+        # entry points with no lock anywhere: the canonical data race.
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._jobs = []
+                    threading.Thread(target=self._drain, daemon=True,
+                                     name="drainer").start()
+
+                def submit(self, job):
+                    self._jobs.append(job)
+
+                def _drain(self):
+                    self._jobs = []
+        """})
+        got = findings(root, rules_concurrency.check_rt007)
+        assert len(got) == 1 and got[0].rule == "RT007"
+        assert "Engine._jobs" in got[0].message
+        roles = got[0].meta["roles"]
+        assert "thread:drainer" in roles and "main" in roles
+
+    def test_guarded_accesses_clean(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._jobs = []
+                    threading.Thread(target=self._drain, daemon=True).start()
+
+                def submit(self, job):
+                    with self._lock:
+                        self._jobs.append(job)
+
+                def _drain(self):
+                    with self._lock:
+                        self._jobs = []
+        """})
+        assert findings(root, rules_concurrency.check_rt007) == []
+
+    def test_interprocedural_lock_held_on_entry(self, tmp_path):
+        # The write lives in a "Lock held." helper whose every call site
+        # holds the lock: entry-set inference must prove it guarded.
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._jobs = []
+                    threading.Thread(target=self._drain, daemon=True).start()
+
+                def submit(self, job):
+                    with self._lock:
+                        self._admit(job)
+
+                def _admit(self, job):
+                    self._jobs.append(job)
+
+                def _drain(self):
+                    with self._lock:
+                        self._admit(None)
+        """})
+        assert findings(root, rules_concurrency.check_rt007) == []
+
+    def test_init_only_writes_are_confined(self, tmp_path):
+        # Written once in __init__, read from another role afterwards:
+        # immutable publication, not a race.
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._cfg = {"x": 1}
+                    threading.Thread(target=self._run, daemon=True).start()
+
+                def _run(self):
+                    return self._cfg
+        """})
+        assert findings(root, rules_concurrency.check_rt007) == []
+
+    def test_declared_guard_map_verified(self, tmp_path):
+        # _RT_GUARDED_BY is a promise the runtime sentinel enforces; a
+        # write that breaks it statically must fail the lint, and a map
+        # row naming a non-lock attribute is itself a finding.
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                _RT_GUARDED_BY = {"_jobs": "_lock", "_oops": "_nolock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._jobs = []
+
+                def submit(self, job):
+                    self._jobs = [job]
+        """})
+        msgs = "\n".join(
+            f.message for f in findings(root, rules_concurrency.check_rt007))
+        assert "declared guarded by '_lock'" in msgs
+        assert "does not hold it" in msgs
+        assert "'_nolock'" in msgs and "not a lock attribute" in msgs
+
+    def test_unguarded_vetting_and_stale_vetting(self, tmp_path):
+        # _RT_UNGUARDED suppresses a vetted handoff; an entry vetting a
+        # field nothing accesses is stale and flagged (allowlist rule).
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                _RT_UNGUARDED = {"_flag": "monotonic bool",
+                                 "_gone": "nothing touches this"}
+
+                def __init__(self):
+                    self._flag = False
+                    threading.Thread(target=self._run, daemon=True).start()
+
+                def _run(self):
+                    self._flag = True
+
+                def stop(self):
+                    self._flag = True
+        """})
+        got = findings(root, rules_concurrency.check_rt007)
+        msgs = "\n".join(f.message for f in got)
+        assert "_flag" not in msgs  # vetted
+        assert "_gone" in msgs and "stale vetting" in msgs
+
+    def test_rt_unguarded_comment_annotation(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._flag = False
+                    threading.Thread(target=self._run, daemon=True).start()
+
+                def _run(self):
+                    self._flag = True  # rt-unguarded: monotonic flip
+
+                def stop(self):
+                    self._flag = True
+        """})
+        assert findings(root, rules_concurrency.check_rt007) == []
+
+    def test_loop_confined_state_touched_off_loop(self, tmp_path):
+        # Async handlers (loop role) share state with an executor target:
+        # the loop-confinement break must flag even with no Thread in
+        # sight.
+        root = make_pkg(tmp_path, {"core/server.py": """
+            class Server:
+                def __init__(self, loop):
+                    self._conns = {}
+                    self._loop = loop
+
+                async def h_accept(self, conn, body):
+                    self._conns[body["id"]] = conn
+                    self._loop.run_in_executor(None, self._flush)
+
+                def _flush(self):
+                    self._conns = {}
+        """})
+        got = findings(root, rules_concurrency.check_rt007)
+        assert len(got) == 1
+        assert "_conns" in got[0].message
+        assert set(got[0].meta["roles"]) >= {"loop", "executor"}
+
+
+# -- RT008: static lock-order cycles -------------------------------------------
+
+
+class TestRT008:
+    def test_abba_cycle_flagged(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        got = findings(root, rules_concurrency.check_rt008)
+        assert len(got) == 1 and got[0].rule == "RT008"
+        assert "lock-order cycle" in got[0].message
+        assert set(got[0].meta["locks"]) == {"Engine._a", "Engine._b"}
+
+    def test_three_lock_cycle_through_call_graph(self, tmp_path):
+        # No direct ABBA anywhere: A nests B only via a call, B nests C
+        # via a call, and a third path nests A under C.  Only composition
+        # through the call graph sees the cycle.
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._c = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        self.g()
+
+                def g(self):
+                    with self._b:
+                        self.h()
+
+                def h(self):
+                    with self._c:
+                        pass
+
+                def k(self):
+                    with self._c:
+                        with self._a:
+                            pass
+        """})
+        got = findings(root, rules_concurrency.check_rt008)
+        assert len(got) == 1
+        assert set(got[0].meta["locks"]) == {
+            "Engine._a", "Engine._b", "Engine._c"}
+
+    def test_consistent_order_clean(self, tmp_path):
+        root = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """})
+        assert findings(root, rules_concurrency.check_rt008) == []
+
+
+# -- RT009: spawn-env contract drift -------------------------------------------
+
+
+_CONFIG_WITH_CONTRACT = """
+    SPAWN_ENV_CONTRACT = {
+        "RT_GOOD_KEY": "a cataloged key",
+        "RT_STALE_KEY": "nothing reads this anymore",
+    }
+
+
+    class Config:
+        direct_calls: bool = True
+"""
+
+
+class TestRT009:
+    def test_three_way_drift(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "core/config.py": _CONFIG_WITH_CONTRACT,
+            "core/boot.py": """
+                import os
+
+                GOOD = os.environ.get("RT_GOOD_KEY")
+                MISSING = os.environ.get("RT_MYSTERY_KEY")
+                SHADOW = os.environ.get("RT_DIRECT_CALLS")
+            """,
+            "core/spawn.py": """
+                def build_env(env):
+                    env["RT_ORPHAN_EXPORT"] = "x"
+                    return dict(env, RT_GOOD_KEY="ok")
+            """,
+        })
+        got = findings(root, rules_config.check_rt009)
+        kinds = {(f.meta["key"], f.meta["kind"]) for f in got}
+        assert ("RT_MYSTERY_KEY", "missing") in kinds
+        assert ("RT_STALE_KEY", "stale") in kinds
+        assert ("RT_DIRECT_CALLS", "shadow") in kinds
+        assert ("RT_ORPHAN_EXPORT", "orphan-write") in kinds
+        assert ("RT_GOOD_KEY", "missing") not in kinds
+
+    def test_const_name_resolution(self, tmp_path):
+        # ENV_FLAG = "RT_X"; os.environ.get(ENV_FLAG) must count as a
+        # read of RT_X (the locks.py idiom).
+        root = make_pkg(tmp_path, {
+            "core/config.py": """
+                SPAWN_ENV_CONTRACT = {"RT_X": "via module constant"}
+
+
+                class Config:
+                    pass
+            """,
+            "core/boot.py": """
+                import os
+
+                ENV_FLAG = "RT_X"
+                VALUE = os.environ.get(ENV_FLAG)
+            """,
+        })
+        assert findings(root, rules_config.check_rt009) == []
+
+    def test_missing_contract_is_a_finding(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "core/config.py": "class Config:\n    pass\n",
+        })
+        got = findings(root, rules_config.check_rt009)
+        assert len(got) == 1 and "SPAWN_ENV_CONTRACT" in got[0].message
+
+
 # -- allowlist -----------------------------------------------------------------
 
 
@@ -370,6 +710,12 @@ class TestPackageGate:
             f"{f.path}:{f.line}: {f.rule} {f.message}" for f in kept
         )
 
+    def test_gate_covers_all_nine_rules(self):
+        """The self-check must run RT001-RT009 — a rule that exists but
+        isn't registered in all_rules() silently stops gating."""
+        names = [r.__name__ for r in all_rules()]
+        assert names == [f"check_rt00{i}" for i in range(1, 10)]
+
     def test_cli_exit_codes(self, tmp_path):
         """`python -m ray_tpu lint` is the operator surface: 0 on the
         clean tree, non-zero once a violation is seeded."""
@@ -393,3 +739,48 @@ class TestPackageGate:
         )
         assert bad.returncode == 1, bad.stdout + bad.stderr
         assert "RT001" in bad.stdout
+
+    def test_cli_seeded_race_and_cycle_exit_nonzero(self, tmp_path):
+        """A seeded cross-role unguarded write and a seeded lock-order
+        cycle must each fail the CLI, and --json must carry the inferred
+        role/guard metadata (the dashboard lint view renders the WHY)."""
+        import json as _json
+
+        seeded = make_pkg(tmp_path, {"core/engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._jobs = []
+                    threading.Thread(target=self._drain, daemon=True,
+                                     name="drainer").start()
+
+                def submit(self, job):
+                    self._jobs.append(job)
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def _drain(self):
+                    self._jobs = []
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "lint", "--json",
+             "--root", str(seeded), "--allowlist", str(tmp_path / "none")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 1, out.stdout + out.stderr
+        payload = _json.loads(out.stdout)
+        by_rule = {}
+        for f in payload["findings"]:
+            by_rule.setdefault(f["rule"], []).append(f)
+        race = by_rule["RT007"][0]
+        assert "thread:drainer" in race["meta"]["roles"]
+        cycle = by_rule["RT008"][0]
+        assert set(cycle["meta"]["locks"]) == {"Engine._a", "Engine._b"}
