@@ -1,0 +1,292 @@
+"""Benchmark: continuous-batching LLM serving under open-loop traffic.
+
+Writes BENCH_SERVE.json: sustained tokens/s, p50/p99 TTFT and ITL at a
+sweep of offered loads, and goodput under 2x overload — CONTINUOUS
+batching (per-step admission into a paged KV cache) vs WHOLE-REQUEST
+batching (gang admission, drain to completion) on the same model, same
+kernels, same traffic.
+
+The traffic generator is OPEN-LOOP (reference methodology: serving
+benchmarks drive Poisson arrivals independent of completions, so queueing
+under saturation is visible instead of hidden by closed-loop self-pacing):
+arrivals ~ Poisson(rate), prompt/output lengths drawn from configurable
+mixes.  Offered loads are fractions of the measured continuous-mode
+saturation capacity, so rows are comparable across boxes.
+
+Usage:
+    python bench_serve.py            # full sweep -> BENCH_SERVE.json
+    python bench_serve.py --smoke    # small counts, no artifact rewrite
+                                     # unless --out is given
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# Length mixes (tokens).  Outputs are deliberately long-tailed: the gap
+# between continuous and whole-request batching IS the tail (a gang drains
+# at the pace of its longest member while short sequences hold dead slots).
+PROMPT_MIX = (4, 8, 12, 16)
+OUTPUT_MIX = (4, 8, 16, 128)
+
+ENGINE_KW = dict(batch_slots=8, page_size=16, max_prompt_len=16,
+                 max_new_tokens_cap=128, max_queue=16)
+
+
+def _build_engine(mode: str, seed: int = 0):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+    # Bigger than `tiny` on purpose: the decode step must dominate the
+    # loop's Python overhead or the batching-policy gap washes out in
+    # per-token bookkeeping noise on small CPU boxes.
+    cfg = LlamaConfig(vocab_size=2048, d_model=384, n_layers=6,
+                      n_heads=8, n_kv_heads=4, d_ff=1152, max_seq=256,
+                      remat=False, dtype=jnp.float32)
+    params = llama_init(cfg, jax.random.PRNGKey(seed))
+    eng = InferenceEngine(cfg, params,
+                          EngineConfig(mode=mode, **ENGINE_KW), seed=seed)
+    eng.warmup()
+    return eng
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals), q))
+
+
+def run_load(engine, rate_rps: float, n_requests: int,
+             seed: int = 0) -> Dict:
+    """Offer ``n_requests`` at Poisson(rate_rps); returns the row dict.
+
+    No consumer thread per request: the engine never blocks on consumers
+    (emission queues are unbounded), so streams are drained AFTER the
+    run and TTFT/ITL come from the engine's own emission timestamps.
+    On a 2-vCPU box, a thread-per-request harness measures mostly its
+    own GIL scheduling — and punishes the higher-throughput mode more
+    (more tokens/s = more consumer wakeups), skewing the comparison."""
+    from ray_tpu.serve.engine import EngineOverloadedError
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    prompts = rng.choice(PROMPT_MIX, size=n_requests)
+    outs = rng.choice(OUTPUT_MIX, size=n_requests)
+    streams = []
+    shed = 0
+    t0 = time.perf_counter()
+    next_t = t0
+    for i in range(n_requests):
+        next_t += gaps[i]
+        delay = next_t - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        prompt = rng.integers(1, 400, size=int(prompts[i]))
+        try:
+            streams.append(engine.submit(prompt,
+                                         max_new_tokens=int(outs[i])))
+        except EngineOverloadedError:
+            shed += 1
+    reqs = []
+    for stream in streams:
+        for _tok in stream:  # drains; engine has already timestamped
+            pass
+        reqs.append(stream._req)
+    done = [r for r in reqs if r.first_token_t is not None]
+    wall = max(r.last_token_t for r in done) - t0 if done else 0.0
+    total_tokens = sum(r.generated for r in done)
+    ttfts = [r.first_token_t - r.submit_t for r in done]
+    itls = [d for r in done for d in r.itls]
+    return {
+        "offered_rps": round(rate_rps, 3),
+        "requests": n_requests,
+        "shed": shed,
+        "completed": len(done),
+        "wall_s": round(wall, 3),
+        # Goodput: tokens of non-shed requests per second of wall — the
+        # "did overload collapse it" number.
+        "tokens_per_s": round(total_tokens / wall, 1) if wall > 0 else 0.0,
+        "p50_ttft_s": _pct(ttfts, 50),
+        "p99_ttft_s": _pct(ttfts, 99),
+        "p50_itl_s": _pct(itls, 50),
+        "p99_itl_s": _pct(itls, 99),
+    }
+
+
+def measure_capacity(engine, n_requests: int, seed: int = 0) -> Dict:
+    """Saturation probe: CLOSED-LOOP — enough concurrent submitters to
+    keep every batch slot occupied for the whole window, so the tail
+    drain of an open-loop burst doesn't dilute the measured rate.
+
+    Lengths ROTATE through the mixes instead of sampling: a whole-request
+    gang's duration is its LONGEST member, so a randomly drawn gang's
+    capacity swings severalfold on composition luck — the rotation holds
+    every gang representative (each length appears equally), which is
+    what makes the continuous/whole-request capacity ratio reproducible
+    on a noisy box."""
+    workers = engine.config.batch_slots + 8
+    iters = max(1, n_requests // workers)
+    rng = np.random.default_rng(seed)
+    tokens = [0]
+    lock = threading.Lock()
+
+    def loop(widx: int):
+        wrng = np.random.default_rng(seed * 1000 + widx)
+        got = 0
+        for it in range(iters):
+            prompt = wrng.integers(
+                1, 400, size=int(PROMPT_MIX[(widx + it) % len(PROMPT_MIX)]))
+            stream = engine.submit(
+                prompt,
+                max_new_tokens=int(OUTPUT_MIX[(widx + it)
+                                              % len(OUTPUT_MIX)]))
+            got += sum(1 for _ in stream)
+        with lock:
+            tokens[0] += got
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=loop, args=(w,), daemon=True)
+               for w in range(workers)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=600)
+    wall = time.perf_counter() - t0
+    return {"tokens_per_s": round(tokens[0] / wall, 1),
+            "requests": workers * iters, "wall_s": round(wall, 3)}
+
+
+def bench_serve_path(n_requests: int = 16) -> Dict:
+    """Tokens/s through the FULL serve stack (replica actor + streaming
+    returns + handle), to bound the per-token serving overhead vs the
+    bare engine."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        handle = serve.run(serve.llm_app(
+            engine=dict(mode="continuous", **ENGINE_KW), warmup=True))
+        stream_handle = handle.options(stream=True)
+        tokens = [0]
+        lock = threading.Lock()
+
+        def consume(n_out):
+            got = sum(1 for _ in stream_handle.remote([5, 7, 11], n_out))
+            with lock:
+                tokens[0] += got
+
+        rng = np.random.default_rng(0)
+        outs = rng.choice(OUTPUT_MIX, size=n_requests)
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=consume, args=(int(o),),
+                                    daemon=True) for o in outs]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        wall = time.perf_counter() - t0
+        return {"requests": n_requests,
+                "tokens_per_s": round(tokens[0] / wall, 1),
+                "wall_s": round(wall, 3)}
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def main(argv=None) -> Dict:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="small counts; skips the serve-path row")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default BENCH_SERVE.json unless "
+                         "--smoke)")
+    args = ap.parse_args(argv)
+
+    n_cap = 24 if args.smoke else 64
+    n_row = 16 if args.smoke else 64
+    levels = (1.0, 2.0) if args.smoke else (0.5, 1.0, 2.0)
+
+    report: Dict = {"metric": "serve_engine_bench",
+                    "engine": ENGINE_KW,
+                    "prompt_mix": list(PROMPT_MIX),
+                    "output_mix": list(OUTPUT_MIX),
+                    "modes": {}, "capacity": {}}
+
+    # SUSTAINED capacity per mode, closed-loop (saturation held for the
+    # whole window).  This is the headline comparison: the ratio of the
+    # two capacities under identical traffic is robust to this box's
+    # scheduling noise where absolute open-loop rates are not.  Two
+    # trials, best-of (interference can only slow a trial down).
+    caps: Dict[str, float] = {}
+    for mode in ("continuous", "whole_request"):
+        eng = _build_engine(mode)
+        trials = [measure_capacity(eng, n_cap, seed=t) for t in range(2)]
+        caps[mode] = max(t["tokens_per_s"] for t in trials)
+        report["capacity"][mode] = {
+            "tokens_per_s": caps[mode], "trials": trials}
+        eng.shutdown()
+    cap_tok_s = caps["continuous"]
+    mean_tokens = float(np.mean(OUTPUT_MIX))
+    cap_rps = cap_tok_s / mean_tokens
+
+    # Open-loop sweep: identical Poisson traffic for both modes at
+    # fractions of CONTINUOUS capacity — the TTFT/ITL-vs-load curves and
+    # the 2x-overload goodput row.
+    for mode in ("continuous", "whole_request"):
+        rows = []
+        for lvl in levels:
+            eng = _build_engine(mode)
+            row = run_load(eng, rate_rps=cap_rps * lvl,
+                           n_requests=n_row, seed=42)
+            row["load_level"] = lvl
+            row["free_list_balanced"] = (
+                eng.allocator.free_count == eng.allocator.total)
+            row["decode_traces"] = eng.stats()["decode_traces"]
+            eng.shutdown()
+            rows.append(row)
+        report["modes"][mode] = rows
+
+    def _at(mode, lvl):
+        return next(r for r in report["modes"][mode]
+                    if r["load_level"] == lvl)
+
+    sat = 1.0 if 1.0 in levels else levels[0]
+    c_sat, w_sat = _at("continuous", sat), _at("whole_request", sat)
+    c_over = _at("continuous", levels[-1])
+    report["summary"] = {
+        "continuous_tokens_per_s": caps["continuous"],
+        "whole_request_tokens_per_s": caps["whole_request"],
+        "continuous_over_whole_request": round(
+            caps["continuous"] / max(caps["whole_request"], 1e-9), 2),
+        "continuous_p99_ttft_s": c_sat["p99_ttft_s"],
+        "whole_request_p99_ttft_s": w_sat["p99_ttft_s"],
+        # Overload posture: goodput at 2x vs 1x offered load (graceful =
+        # stays near 1.0 while shedding the excess).
+        "overload_goodput_ratio": round(
+            c_over["tokens_per_s"] / max(c_sat["tokens_per_s"], 1e-9), 2),
+        "overload_shed": c_over["shed"],
+    }
+
+    if not args.smoke:
+        report["serve_path"] = bench_serve_path()
+
+    out = args.out or (None if args.smoke else "BENCH_SERVE.json")
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report["summary"]))
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -141,5 +141,12 @@ class ObjectRefGenerator:
         self._index += 1
         return ObjectRef(ObjectID(item["object_id"]))
 
+    def cancel(self, force: bool = False) -> None:
+        """Cancel the producing task (reference: ray.cancel on a streaming
+        generator's task).  The worker raises TaskCancelledError inside the
+        generator body, which closes it — a token-streaming deployment
+        frees its engine state mid-flight this way."""
+        ctx.client.cancel_task(self._task_id, force)
+
     def __reduce__(self):
         return (ObjectRefGenerator, (self._task_id,))

@@ -132,13 +132,18 @@ def _exec_part_body(source: Source, ops: List[Op]) -> Block:
     block = source() if callable(source) else source
     for op in ops:
         block = op(block)
+    _emit_stage_metrics(source, ops, block, _time.perf_counter() - t0)
+    return block
+
+
+def _emit_stage_metrics(source: Source, ops: List[Op], block: Block,
+                        wall: float) -> None:
     # Per-stage throughput telemetry: two counters per part (rows and
     # wall-seconds, tagged by the fused stage) — rows/sec is their ratio,
     # and its trend is visible in the head's metrics history.
     try:
         from ray_tpu.util.metrics import get_counter, get_gauge
 
-        wall = _time.perf_counter() - t0
         tags = {"stage": _stage_name(source, ops)}
         get_counter("ray_tpu_data_rows_total",
                     "Rows produced per dataset stage",
@@ -160,7 +165,6 @@ def _exec_part_body(source: Source, ops: List[Op]) -> Block:
                 tags={**tags, "pid": str(_os.getpid())})
     except Exception:
         pass  # telemetry must never fail a data task
-    return block
 
 
 @ray_tpu.remote
@@ -169,25 +173,53 @@ def _exec_part(source: Source, ops: List[Op]) -> Block:
 
 
 @ray_tpu.remote
-def _exec_part_profiled(source: Source, ops: List[Op]) -> List[tuple]:
-    """Run the chain timing each operator; returns
-    [(op_name, wall_s, rows_out), ...] including the source read.  This is
-    the Dataset.stats() backend (reference: op runtime metrics are sampled
-    during normal execution; here profiling is an explicit pass so the hot
-    path stays timer-free)."""
+def _exec_part_timed(source: Source, ops: List[Op]):
+    """The materialize() executor: the block PLUS per-operator timings as
+    a second return (submitted with num_returns=2), so Dataset.stats()
+    can report the LAST RUN's breakdown without re-executing the plan.
+    The timing rows are a few tuples per part — negligible next to the
+    block itself."""
     import time as _time
 
-    out: List[tuple] = []
-    t0 = _time.perf_counter()
+    rows: List[tuple] = []
+    t_start = _time.perf_counter()
+    t0 = t_start
     block = source() if callable(source) else source
-    name = getattr(source, "name", "Source")
-    out.append((name, _time.perf_counter() - t0, block.num_rows))
+    rows.append((getattr(source, "name", "Source"),
+                 _time.perf_counter() - t0, block.num_rows))
     for op in ops:
         t0 = _time.perf_counter()
         block = op(block)
-        out.append((_op_name(op), _time.perf_counter() - t0,
-                    block.num_rows))
-    return out
+        rows.append((_op_name(op), _time.perf_counter() - t0,
+                     block.num_rows))
+    _emit_stage_metrics(source, ops, block,
+                        _time.perf_counter() - t_start)
+    return block, rows
+
+
+def _aggregate_op_rows(per_part: List[List[tuple]]
+                       ) -> List[Dict[str, Any]]:
+    """Fold [(op, wall, rows), ...] per part into the stats() operator
+    table (tasks / rows_out / wall totals per operator)."""
+    operators: List[Dict[str, Any]] = []
+    agg: Dict[str, Dict[str, Any]] = {}
+    for rows in per_part:
+        for name, wall, n_rows in rows:
+            ent = agg.get(name)
+            if ent is None:
+                ent = agg[name] = {
+                    "operator": name, "tasks": 0, "rows_out": 0,
+                    "wall_total_s": 0.0,
+                }
+                operators.append(ent)
+            ent["tasks"] += 1
+            ent["rows_out"] += int(n_rows)
+            ent["wall_total_s"] += float(wall)
+    for ent in operators:
+        ent["wall_total_s"] = round(ent["wall_total_s"], 6)
+        ent["wall_mean_s"] = round(
+            ent["wall_total_s"] / max(ent["tasks"], 1), 6)
+    return operators
 
 
 @ray_tpu.remote
@@ -395,10 +427,21 @@ def _join_key_digestable(v) -> str:
         v = v.item()
     if isinstance(v, bool) or not isinstance(v, (int, float)):
         return repr(v)
-    f = float(v)
-    if f == v and abs(f) < 2.0 ** 53:  # exactly representable: canonical
+    try:
+        f = float(v)
+    except OverflowError:  # int beyond float range: no float equals it
+        return repr(v)
+    if f != v:
+        return repr(v)  # int not exactly representable: no float equals it
+    if abs(f) < 2.0 ** 53:  # exactly representable: canonical
         return repr(f)
-    return repr(v)
+    # |v| >= 2**53: repr(float) and repr(int) diverge for EQUAL values
+    # (1 << 53 vs 9.007199254740992e+15) — integer-valued keys share the
+    # exact integer form so int and float keys that compare equal route
+    # to the same partition.  All floats this large are integers.
+    if isinstance(v, int):
+        return repr(v)
+    return repr(int(f)) if f.is_integer() else repr(f)
 
 
 @ray_tpu.remote
@@ -804,6 +847,10 @@ class Dataset:
         # The inspectable plan description (reference: logical_plan.py);
         # optimize() fires fusion/pushdown rules over it (logical.py).
         self._logical = logical if logical is not None else LogicalPlan()
+        # Per-operator rows from the last materialize() of/into this
+        # dataset (None until then) — lets stats() report that run
+        # instead of re-executing the plan.
+        self._materialized_stats: Optional[List[Dict[str, Any]]] = None
 
     # ---------------------------------------------------------- transforms
 
@@ -1244,7 +1291,8 @@ class Dataset:
 
     # ------------------------------------------------------------ execution
 
-    def _iter_block_refs(self, window: Optional[int] = None) -> Iterator[Any]:
+    def _iter_block_refs(self, window: Optional[int] = None,
+                         timed_sink: Optional[List] = None) -> Iterator[Any]:
         """Launch part tasks with a bounded in-flight window, yielding block
         refs in plan order (the pull-based streaming executor: the consumer's
         pace bounds cluster work — reference: streaming_executor.py:48).
@@ -1296,6 +1344,14 @@ class Dataset:
                     ref = pools.submit(src, ops, pool)
                 elif not ops and not callable(src):
                     ref = src  # already-materialized block: no task needed
+                elif timed_sink is not None:
+                    # Opportunistic per-operator timing (materialize):
+                    # same chain, block + timing rows as two returns.
+                    # Pool-routed and pre-materialized parts above carry
+                    # no timings (documented in stats()).
+                    ref, t_ref = _exec_part_timed.options(
+                        num_returns=2).remote(src, ops)
+                    timed_sink.append(t_ref)
                 else:
                     ref = _exec_part.remote(src, ops)
                 pending.append(ref)
@@ -1325,8 +1381,8 @@ class Dataset:
         for ref in self._iter_block_refs():
             yield ray_tpu.get(ref)
 
-    def _materialize_refs(self) -> tuple:
-        refs = list(self._iter_block_refs())
+    def _materialize_refs(self, timed_sink: Optional[List] = None) -> tuple:
+        refs = list(self._iter_block_refs(timed_sink=timed_sink))
         if self._counts is not None and builtins.all(
             not ops and not callable(src) for src, ops in self._parts
         ):
@@ -1338,10 +1394,24 @@ class Dataset:
 
     def materialize(self) -> "Dataset":
         """Execute the plan; the result holds materialized block refs
-        (reference: dataset.py materialize:4622)."""
-        refs, counts = self._materialize_refs()
-        return Dataset([(r, []) for r in refs], counts,
-                       logical=self._logical)
+        (reference: dataset.py materialize:4622).  Per-operator timings
+        are collected opportunistically during this run (the timed
+        executor's second return) and stashed on both this dataset and
+        the result, so a following ``stats()`` reports THIS execution
+        instead of profiling a second one."""
+        sink: List = []
+        refs, counts = self._materialize_refs(timed_sink=sink)
+        stats = None
+        if sink:
+            try:
+                stats = _aggregate_op_rows(ray_tpu.get(sink))
+            except Exception:
+                stats = None  # timing is best-effort, never fails the run
+        out = Dataset([(r, []) for r in refs], counts,
+                      logical=self._logical)
+        self._materialized_stats = stats
+        out._materialized_stats = stats
+        return out
 
     # --------------------------------------------------------- plan insight
 
@@ -1358,36 +1428,31 @@ class Dataset:
         return "\n".join(lines)
 
     def stats(self) -> Dict[str, Any]:
-        """Per-operator rows/wall breakdown from a profiled execution of
-        the plan, plus the optimized stage list (reference: dataset.py
-        stats:4790 returns per-operator wall/rows/output sizes).  Profiling
-        runs the chain once with timers; the normal execution path carries
-        no timing overhead."""
-        per_part = ray_tpu.get([
-            _exec_part_profiled.remote(src, ops)
-            for src, ops in self._plan_parts()
-        ])
-        operators: List[Dict[str, Any]] = []
-        agg: Dict[str, Dict[str, Any]] = {}
-        for rows in per_part:
-            for name, wall, n_rows in rows:
-                ent = agg.get(name)
-                if ent is None:
-                    ent = agg[name] = {
-                        "operator": name, "tasks": 0, "rows_out": 0,
-                        "wall_total_s": 0.0,
-                    }
-                    operators.append(ent)
-                ent["tasks"] += 1
-                ent["rows_out"] += int(n_rows)
-                ent["wall_total_s"] += float(wall)
-        for ent in operators:
-            ent["wall_total_s"] = round(ent["wall_total_s"], 6)
-            ent["wall_mean_s"] = round(
-                ent["wall_total_s"] / max(ent["tasks"], 1), 6)
+        """Per-operator rows/wall breakdown plus the optimized stage list
+        (reference: dataset.py stats:4790 returns per-operator
+        wall/rows/output sizes).
+
+        If this dataset ran (or came out of) ``materialize()``, the
+        breakdown is that run's opportunistically collected timings —
+        no extra work.  OTHERWISE THIS METHOD EXECUTES THE WHOLE PLAN
+        once more in a profiled pass: side-effecting UDFs run AGAIN and
+        large reads decode AGAIN.  Call ``materialize()`` first when that
+        matters.  (Pool-routed chains also materialize through their
+        actor pool before profiling, so their breakdown collapses to the
+        materialized source.)"""
+        operators = self._materialized_stats
+        source = "last_materialize"
+        if operators is None:
+            source = "profiled_pass"
+            timing_refs = [
+                _exec_part_timed.options(num_returns=2).remote(src, ops)[1]
+                for src, ops in self._plan_parts()
+            ]
+            operators = _aggregate_op_rows(ray_tpu.get(timing_refs))
         optimized, fired = self._logical.optimize()
         return {
             "operators": operators,
+            "operators_source": source,
             "num_blocks": len(self._parts),
             # Map chains execute inside ONE task per block — the physical
             # realization of the fusion rule.

@@ -23,12 +23,20 @@ from .llama import (
     lora_sharding_rules,
 )
 from .mlp import MLPConfig, mlp_apply, mlp_init
+from .paged import (
+    PageAllocator,
+    init_paged_pools,
+    paged_decode_step,
+    paged_prefill,
+)
 from .moe import MoEConfig, moe_apply, moe_init, moe_loss, moe_sharding_rules
 from .train_state import TrainState, make_train_step
 
 __all__ = [
     "LlamaConfig", "llama_init", "llama_apply", "llama_loss",
     "generate", "init_kv_cache", "llama_prefill", "llama_decode_step",
+    "PageAllocator", "init_paged_pools", "paged_prefill",
+    "paged_decode_step",
     "llama_sharding_rules", "lora_init", "lora_merge", "lora_sharding_rules",
     "MLPConfig", "mlp_init", "mlp_apply",
     "MoEConfig", "moe_init", "moe_apply", "moe_loss", "moe_sharding_rules",

@@ -1,0 +1,260 @@
+"""Paged (blocked) KV cache + decode/prefill programs for the serve engine.
+
+Role-equivalent to vLLM-style PagedAttention as surfaced by Ray Serve's LLM
+stack (reference: the Ray Serve LLM APIs run a continuous-batching engine
+whose KV cache is a pool of fixed-size pages).  TPU-first shape, same
+recipe as `generate.py` but paged:
+
+- ONE preallocated KV pool per replica: ``[L, P+1, H_kv, page, D]`` per
+  k/v; page ``P`` is a scratch page that absorbs writes from inactive
+  batch slots and padded prompt tail positions, so every program runs
+  with fully static shapes and no data-dependent control flow.
+- A host-side free-list allocator hands pages to sequences; per-sequence
+  PAGE TABLES (``[MAX_PAGES]`` int32, scratch-filled past the allocated
+  prefix) are plain arrays, so ONE compiled decode program serves any
+  admission mix — slot occupancy, page placement, and lengths are data.
+- The decode step gathers each slot's pages into a linear view and masks
+  by sequence length (the standard static-shape TPU decode recipe: score
+  the whole gather, mask the unwritten tail — no dynamic slicing).
+
+Compile counts are observable via ``trace_count()`` — the jitted bodies
+bump a counter when TRACED (python executes only at trace time), which is
+how tests assert the engine never recompiles after warmup.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rotary, rope_frequencies
+from .llama import LlamaConfig, _mlp
+
+Params = Any
+PagedPools = Dict[str, jax.Array]  # {"k": [L, P+1, H_kv, page, D], "v": ...}
+
+#: jit-trace counters per program name; a bump means XLA compiled a new
+#: specialization (python bodies only run while tracing).
+_TRACE_COUNTS: Dict[str, int] = {}
+
+
+def trace_count(name: str) -> int:
+    """Times the named program (``"decode"`` / ``"prefill"``) was traced."""
+    return _TRACE_COUNTS.get(name, 0)
+
+
+def _bump(name: str) -> None:
+    _TRACE_COUNTS[name] = _TRACE_COUNTS.get(name, 0) + 1
+
+
+def init_paged_pools(config: LlamaConfig, num_pages: int,
+                     page_size: int) -> PagedPools:
+    """One pool pair for the whole replica; index ``num_pages`` is the
+    scratch page (writes routed there are never read)."""
+    shape = (config.n_layers, num_pages + 1, config.n_kv_heads,
+             page_size, config.head_dim)
+    return {"k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype)}
+
+
+class PageAllocator:
+    """Free-list page allocator (host side; the engine serializes access).
+
+    All-or-nothing ``alloc``: a sequence is admitted only when its whole
+    worst-case footprint fits, so decode can never die of page exhaustion
+    mid-flight — admission control happens at the boundary, not inside
+    the loop.  Double frees fail loudly (a page on two sequences corrupts
+    both)."""
+
+    def __init__(self, num_pages: int):
+        self.total = num_pages
+        self._free: List[int] = list(range(num_pages))
+        self._allocated: set = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.total - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, or None when the pool can't cover them (caller queues
+        or sheds — never partial)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p not in self._allocated:
+                raise AssertionError(f"double free of KV page {p}")
+            self._allocated.discard(p)
+            self._free.append(p)
+
+
+def _rotary_single(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                   pos: jax.Array) -> jax.Array:
+    """RoPE for one position per batch slot: x [B, H, D], pos [B]."""
+    c = cos[pos][:, None, :]  # [B, 1, D/2]
+    s = sin[pos][:, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+def _sample_tokens(logits: jax.Array, temps: jax.Array,
+                   key: jax.Array) -> jax.Array:
+    """Per-slot greedy/temperature sampling: logits [B, V], temps [B]
+    (<= 0 means greedy)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    keys = jax.random.split(key, logits.shape[0])
+    sampled = jax.vmap(jax.random.categorical)(keys, logits / safe_t)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def paged_decode_step(config: LlamaConfig, params: Params,
+                      pools: PagedPools, tokens: jax.Array,
+                      page_tables: jax.Array, seq_lens: jax.Array,
+                      active: jax.Array, temps: jax.Array,
+                      key: jax.Array):
+    """One decode step for every batch slot at once.
+
+    tokens [B] int32 (last sampled token per slot), page_tables [B, MAXP]
+    int32 (scratch index past each sequence's allocated prefix), seq_lens
+    [B] int32 = tokens already cached (the new token is WRITTEN at
+    position seq_lens and attends positions <= seq_lens), active [B]
+    bool, temps [B] float32.  Inactive slots pass seq_lens=0 and an
+    all-scratch page table: their writes land on the scratch page and
+    their sampled token is ignored host-side.  Pools are donated —
+    steady-state decode never copies the cache.
+
+    The PRNG key and the slot lengths advance ON DEVICE (returned
+    alongside the tokens), so the serving loop's only per-step host
+    traffic is downloading the [B] sampled tokens — host-side key
+    folding measurably dominates step time otherwise.  Returns
+    (next_tokens [B], new_seq_lens [B], new_key, pools)."""
+    _bump("decode")
+    B = tokens.shape[0]
+    maxp = page_tables.shape[1]
+    ps = pools["k"].shape[3]
+    n_rep = config.n_heads // config.n_kv_heads
+    x = params["embed"][tokens].astype(config.dtype)  # [B, d]
+    cos, sin = rope_frequencies(config.head_dim, maxp * ps,
+                                config.rope_theta)
+    k_pool, v_pool = pools["k"], pools["v"]
+    b_idx = jnp.arange(B)
+    page_idx = page_tables[b_idx, seq_lens // ps]  # [B]
+    off = seq_lens % ps
+    pos_grid = jnp.arange(maxp * ps)[None, None, :]  # [1, 1, MAXP*ps]
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        a = layer["attn"]
+        q = (h @ a["wq"]).reshape(B, config.n_heads, config.head_dim)
+        k = (h @ a["wk"]).reshape(B, config.n_kv_heads, config.head_dim)
+        v = (h @ a["wv"]).reshape(B, config.n_kv_heads, config.head_dim)
+        q = _rotary_single(q, cos, sin, seq_lens)
+        k = _rotary_single(k, cos, sin, seq_lens)
+        k_pool = k_pool.at[i, page_idx, :, off, :].set(
+            k.astype(k_pool.dtype))
+        v_pool = v_pool.at[i, page_idx, :, off, :].set(
+            v.astype(v_pool.dtype))
+        # Gather each slot's pages into a linear [B, H_kv, MAXP*ps, D]
+        # view; the length mask removes scratch/unwritten positions.
+        k_seq = k_pool[i, page_tables].transpose(0, 2, 1, 3, 4).reshape(
+            B, config.n_kv_heads, maxp * ps, config.head_dim)
+        v_seq = v_pool[i, page_tables].transpose(0, 2, 1, 3, 4).reshape(
+            B, config.n_kv_heads, maxp * ps, config.head_dim)
+        if n_rep > 1:  # GQA: repeat kv heads query-side
+            k_seq = jnp.repeat(k_seq, n_rep, axis=1)
+            v_seq = jnp.repeat(v_seq, n_rep, axis=1)
+        scores = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                            k_seq.astype(jnp.float32)) \
+            * (config.head_dim ** -0.5)
+        scores = jnp.where(pos_grid <= seq_lens[:, None, None],
+                           scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+        out = jnp.einsum("bhk,bhkd->bhd", probs, v_seq)
+        x = x + out.reshape(B, -1) @ a["wo"]
+        h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        x = x + _mlp(layer, h)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    key, sub = jax.random.split(key)
+    toks = _sample_tokens(logits, temps, sub)
+    new_lens = jnp.where(active, seq_lens + 1, 0)
+    return toks, new_lens, key, {"k": k_pool, "v": v_pool}
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def paged_prefill(config: LlamaConfig, params: Params, pools: PagedPools,
+                  tokens: jax.Array, length: jax.Array,
+                  page_table: jax.Array, temp: jax.Array, key: jax.Array):
+    """Prefill ONE sequence's prompt into its pages and sample the first
+    token.
+
+    tokens [1, S_pad] int32 (prompt padded to a bucket length — one
+    compile per bucket, see the engine's bucket table), length scalar =
+    real prompt length, page_table [MAXP].  Padded tail positions write
+    through the page table like real ones (their garbage K/V is masked by
+    length until decode overwrites it) or to the scratch page past the
+    allocated prefix.  The key advances on device like the decode step's.
+    Returns (first_token scalar, new_key, pools)."""
+    _bump("prefill")
+    _, s_pad = tokens.shape
+    ps = pools["k"].shape[3]
+    n_rep = config.n_heads // config.n_kv_heads
+    x = params["embed"][tokens[0]].astype(config.dtype)  # [S_pad, d]
+    cos, sin = rope_frequencies(config.head_dim, s_pad, config.rope_theta)
+    k_pool, v_pool = pools["k"], pools["v"]
+    positions = jnp.arange(s_pad)
+    page_idx = page_table[positions // ps]  # [S_pad]
+    off = positions % ps
+    row = positions[:, None]
+    col = positions[None, :]
+    causal = col <= row  # [S_pad, S_pad]
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        a = layer["attn"]
+        q = (h @ a["wq"]).reshape(s_pad, config.n_heads, config.head_dim
+                                  ).transpose(1, 0, 2)  # [H, S, D]
+        k = (h @ a["wk"]).reshape(s_pad, config.n_kv_heads, config.head_dim
+                                  ).transpose(1, 0, 2)
+        v = (h @ a["wv"]).reshape(s_pad, config.n_kv_heads, config.head_dim
+                                  ).transpose(1, 0, 2)
+        q = apply_rotary(q[None], cos, sin)[0]
+        k = apply_rotary(k[None], cos, sin)[0]
+        k_pool = k_pool.at[i, page_idx, :, off, :].set(
+            k.transpose(1, 0, 2).astype(k_pool.dtype))
+        v_pool = v_pool.at[i, page_idx, :, off, :].set(
+            v.transpose(1, 0, 2).astype(v_pool.dtype))
+        kr, vr = k, v
+        if n_rep > 1:
+            kr = jnp.repeat(kr, n_rep, axis=0)
+            vr = jnp.repeat(vr, n_rep, axis=0)
+        scores = jnp.einsum("hqd,hkd->hqk", q.astype(jnp.float32),
+                            kr.astype(jnp.float32)) \
+            * (config.head_dim ** -0.5)
+        scores = jnp.where(causal[None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vr.dtype)
+        out = jnp.einsum("hqk,hkd->hqd", probs, vr)
+        x = x + out.transpose(1, 0, 2).reshape(s_pad, -1) @ a["wo"]
+        h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        x = x + _mlp(layer, h)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x_last = jnp.take(x, length - 1, axis=0)  # last REAL position
+    logits = (x_last @ params["lm_head"]).astype(jnp.float32)[None]
+    key, sub = jax.random.split(key)
+    tok = _sample_tokens(logits, temp[None], sub)[0]
+    return tok, key, {"k": k_pool, "v": v_pool}

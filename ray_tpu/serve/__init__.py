@@ -20,6 +20,13 @@ from .api import (
 )
 from .batching import batch
 from .config import deploy as deploy_config
+from .engine import (
+    EngineConfig,
+    EngineOverloadedError,
+    InferenceEngine,
+    LLMServer,
+    llm_app,
+)
 from .grpc_ingress import start_grpc, stop_grpc
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -30,4 +37,6 @@ __all__ = [
     "DeploymentResponse", "batch", "start_http", "stop_http",
     "multiplexed", "get_multiplexed_model_id", "deploy_config",
     "start_grpc", "stop_grpc",
+    "EngineConfig", "EngineOverloadedError", "InferenceEngine",
+    "LLMServer", "llm_app",
 ]

@@ -217,6 +217,7 @@ class _HttpProxy:
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
                 self.end_headers()
+                completed = False
                 try:
                     for item in stream:
                         self.wfile.write(
@@ -224,8 +225,9 @@ class _HttpProxy:
                         self.wfile.flush()
                     self.wfile.write(b"event: done\ndata: null\n\n")
                     self.wfile.flush()
+                    completed = True
                 except (BrokenPipeError, ConnectionResetError):
-                    pass  # client hung up mid-stream: stop consuming
+                    pass  # client hung up: the finally cancels
                 except Exception as e:  # noqa: BLE001 — headers are out;
                     # the error must travel IN the stream, not as a status.
                     try:
@@ -235,6 +237,14 @@ class _HttpProxy:
                         self.wfile.flush()
                     except OSError:
                         pass
+                finally:
+                    if not completed:
+                        # ANY non-complete exit (client hangup, write
+                        # timeout, serialization error) cancels the
+                        # replica-side generator so an engine-backed
+                        # deployment stops decoding and frees its KV
+                        # pages mid-flight.  Idempotent.
+                        stream.cancel()
 
             def do_POST(self):  # noqa: N802 — stdlib naming
                 name = self.path.strip("/").split("/")[0]

@@ -1,0 +1,634 @@
+"""Continuous-batching LLM inference engine behind serve.
+
+Role-equivalent to the Ray Serve LLM stack's engine loop (reference: Ray
+Serve's LLM deployments wrap a continuous-batching engine; PAPER.md L7
+names model multiplexing + streaming as the serve capability surface).
+The engine turns a replica from a request router into an inference loop:
+
+- ONE decode program (``models/paged.py``) serves every admission mix —
+  batch slots, page tables, and lengths are data, so after warmup the
+  loop never recompiles.
+- Queued sequences are admitted into free batch slots BETWEEN decode
+  steps; a prefill runs as its own (bucketed) program, so running
+  sequences stall by at most one step per admission.
+- Finished/cancelled sequences are evicted between steps and their pages
+  return to the free list; the page pool's worst-case footprint is
+  reserved at admission, so decode can never die of page exhaustion
+  mid-flight.
+- Admission control sheds with a typed :class:`EngineOverloadedError`
+  when the wait queue exceeds its bound — goodput holds under overload
+  instead of collapsing into unbounded queueing.
+- Tokens stream out per-request as they decode (the deployment's sync
+  generator feeds serve's existing per-item streaming path: handles,
+  HTTP SSE, gRPC server-streaming); a consumer that disappears cancels
+  the request and frees its pages mid-flight.
+
+``mode="whole_request"`` keeps the same kernels but only admits when the
+batch is EMPTY (gang admission, drain to completion) — the baseline
+``bench_serve.py`` compares against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class EngineOverloadedError(Exception):
+    """Typed admission-control shed: the engine's wait queue is full.
+
+    Callers see this at submit time (the request never held pages or a
+    slot); clients should back off and retry — the standard overload
+    contract (reference: Serve's backpressure returns 503)."""
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Sizing knobs for one replica's engine.
+
+    ``page_table_width`` (MAXP) and the pool size derive from the prompt
+    and output caps so admission's worst-case reservation always fits a
+    fresh pool: ``num_pages = 0`` auto-sizes to ``batch_slots`` times the
+    per-sequence worst case."""
+
+    batch_slots: int = 8
+    page_size: int = 16
+    max_prompt_len: int = 64
+    max_new_tokens_cap: int = 128
+    num_pages: int = 0            # 0 -> batch_slots * pages_per_seq
+    max_queue: int = 32           # admission bound: beyond this, shed
+    mode: str = "continuous"      # or "whole_request" (gang admission)
+    stream_timeout_s: float = 120.0
+
+    @property
+    def pages_per_seq(self) -> int:
+        # The page table must cover BOTH the worst-case sequence AND the
+        # largest prefill bucket: padded prefill positions index the
+        # table, and jit clamps an out-of-range gather to the last entry
+        # — which would silently corrupt a real page.
+        worst = math.ceil(
+            (self.max_prompt_len + self.max_new_tokens_cap)
+            / self.page_size)
+        return max(worst, self.prefill_buckets()[-1] // self.page_size)
+
+    @property
+    def pool_pages(self) -> int:
+        return self.num_pages or self.batch_slots * self.pages_per_seq
+
+    def prefill_buckets(self) -> List[int]:
+        """Padded prompt lengths (one compile each): page-size multiples
+        doubling up to the prompt cap."""
+        out, b = [], self.page_size
+        while b < self.max_prompt_len:
+            out.append(b)
+            b *= 2
+        out.append(max(b, self.max_prompt_len))
+        return out
+
+
+class _Request:
+    __slots__ = (
+        "req_id", "prompt", "max_new", "temperature", "stop_token",
+        "out_q", "cancelled", "finished", "pages", "page_table",
+        "length", "generated", "submit_t", "first_token_t",
+        "last_token_t", "itls", "slot",
+    )
+
+    def __init__(self, req_id: int, prompt: np.ndarray, max_new: int,
+                 temperature: float, stop_token: Optional[int]):
+        self.req_id = req_id
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.stop_token = stop_token
+        self.out_q: "_queue.Queue" = _queue.Queue()
+        self.cancelled = threading.Event()
+        self.finished = False
+        self.pages: List[int] = []
+        self.page_table: Optional[np.ndarray] = None
+        self.length = 0
+        self.generated = 0
+        self.submit_t = time.perf_counter()
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        # Engine-side inter-token latencies: measured at emission, so
+        # they reflect decode cadence, not consumer scheduling.
+        self.itls: List[float] = []
+        self.slot = -1
+
+
+class TokenStream:
+    """Per-request token iterator; the consumer side of the engine's
+    emission queue.  ``cancel()`` (or closing the iterating generator)
+    releases the request's slot and pages at the next step boundary."""
+
+    def __init__(self, engine: "InferenceEngine", req: _Request):
+        self._engine = engine
+        self._req = req
+        self.steps: List[int] = []   # decode-step index of each token
+        self.ttft_s: Optional[float] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        try:
+            kind, payload, step = self._req.out_q.get(
+                timeout=self._engine.config.stream_timeout_s)
+        except _queue.Empty:
+            self.cancel()
+            raise RuntimeError(
+                "engine stream stalled past stream_timeout_s") from None
+        if kind == "tok":
+            if self.ttft_s is None and self._req.first_token_t is not None:
+                self.ttft_s = self._req.first_token_t - self._req.submit_t
+            self.steps.append(step)
+            return int(payload)
+        if kind == "err":
+            raise payload
+        raise StopIteration  # ("done", reason)
+
+    def cancel(self) -> None:
+        self._engine.cancel(self._req)
+
+
+class InferenceEngine:
+    """One replica's decode loop: host-side sequence/slot state machine
+    around the jitted paged programs.  The loop runs on a dedicated
+    daemon thread; ``submit()`` is called from any number of request
+    threads and only touches the wait queue under the lock — pools,
+    allocator, and slot arrays belong to the loop thread alone."""
+
+    def __init__(self, model_config, params, config: EngineConfig,
+                 seed: int = 0):
+        import jax
+
+        from ..models.paged import (PageAllocator, init_paged_pools)
+        from ..util.metrics import get_counter, get_gauge, get_histogram
+
+        self.model_config = model_config
+        self.params = params
+        self.config = config
+        cfg = config
+        self.maxp = cfg.pages_per_seq
+        self.scratch = cfg.pool_pages  # scratch page index
+        self.pools = init_paged_pools(model_config, cfg.pool_pages,
+                                      cfg.page_size)
+        self.allocator = PageAllocator(cfg.pool_pages)
+        # ONE device-resident PRNG key threads through every prefill and
+        # decode call (each program splits and returns the successor):
+        # host-side fold_in per step costs more than the decode math.
+        # Sampling is therefore seeded per ENGINE, not per request.
+        self._d_key = jax.random.PRNGKey(seed)
+        b = cfg.batch_slots
+        self.slots: List[Optional[_Request]] = [None] * b
+        # Host mirrors are the rebuild source; the device copies below are
+        # what decode consumes.  Admission/eviction/prefill mutate the
+        # mirrors and mark them dirty; steady-state decode advances
+        # tokens/lengths ON DEVICE and never re-uploads.
+        self._page_tables = np.full((b, self.maxp), self.scratch, np.int32)
+        self._seq_lens = np.zeros((b,), np.int32)
+        self._tokens = np.zeros((b,), np.int32)
+        self._active = np.zeros((b,), bool)
+        self._temps = np.zeros((b,), np.float32)
+        self._dirty = True
+        self._d_tokens = self._d_page_tables = None
+        self._d_seq_lens = self._d_active = self._d_temps = None
+        self.step_count = 0
+        self._req_counter = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._pending: List[_Request] = []
+        self._stop = False
+        self.completed = 0
+        self.shed = 0
+        self.cancelled_count = 0
+        # Instruments hoisted off the request path (registry lock).
+        self._m_tokens = get_counter(
+            "ray_tpu_gen_tokens_total",
+            "Decoded tokens emitted by the inference engine")
+        self._m_prefill = get_counter(
+            "ray_tpu_gen_prefill_tokens_total",
+            "Prompt tokens prefilled into the paged KV cache")
+        self._m_pages = get_gauge(
+            "ray_tpu_gen_kv_pages_in_use",
+            "KV cache pages currently allocated to sequences",
+            tag_keys=("pid",))
+        self._m_queue = get_gauge(
+            "ray_tpu_serve_engine_queue_depth",
+            "Requests waiting for a batch slot", tag_keys=("pid",))
+        self._m_active = get_gauge(
+            "ray_tpu_serve_engine_active_seqs",
+            "Sequences decoding in batch slots", tag_keys=("pid",))
+        self._m_shed = get_counter(
+            "ray_tpu_serve_engine_shed_total",
+            "Requests rejected by admission control (overload)")
+        self._m_completed = get_counter(
+            "ray_tpu_serve_engine_completed_total",
+            "Requests decoded to completion")
+        self._m_cancelled = get_counter(
+            "ray_tpu_serve_engine_cancelled_total",
+            "Requests cancelled mid-flight (pages reclaimed)")
+        self._m_ttft = get_histogram(
+            "ray_tpu_serve_engine_ttft_seconds",
+            "Submit-to-first-token latency",
+            boundaries=(0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10))
+        self._m_itl = get_histogram(
+            "ray_tpu_serve_engine_itl_seconds",
+            "Inter-token latency during decode",
+            boundaries=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 1))
+        import os
+
+        self._pid_tags = {"pid": str(os.getpid())}
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="llm-engine")
+        self._thread.start()
+
+    # ------------------------------------------------------------- client API
+
+    def submit(self, prompt_tokens, max_new_tokens: int = 16,
+               temperature: float = 0.0,
+               stop_token: Optional[int] = None) -> TokenStream:
+        """Queue one sequence; returns its token stream.  Sheds with
+        :class:`EngineOverloadedError` when the wait queue is full."""
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if prompt.size == 0 or prompt.size > self.config.max_prompt_len:
+            raise ValueError(
+                f"prompt length {prompt.size} outside (0, "
+                f"{self.config.max_prompt_len}]")
+        max_new = min(int(max_new_tokens), self.config.max_new_tokens_cap)
+        if max_new <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        need = math.ceil((prompt.size + max_new) / self.config.page_size)
+        if need > self.allocator.total:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool holds only "
+                f"{self.allocator.total} — raise EngineConfig.num_pages")
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("engine is shut down")
+            if len(self._pending) >= self.config.max_queue:
+                self.shed += 1
+                self._m_shed.inc(1)
+                raise EngineOverloadedError(
+                    f"engine queue full ({self.config.max_queue} waiting)")
+            self._req_counter += 1
+            req = _Request(self._req_counter, prompt, max_new,
+                           float(temperature), stop_token)
+            self._pending.append(req)
+            self._m_queue.set(len(self._pending), tags=self._pid_tags)
+            self._wake.notify()
+        return TokenStream(self, req)
+
+    def cancel(self, req: _Request) -> None:
+        """Idempotent; a finished request is a no-op.  Pages return to
+        the free list at the loop's next step boundary."""
+        req.cancelled.set()
+        with self._lock:
+            self._wake.notify()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._wake.notify()
+        self._thread.join(timeout=10)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            queued = len(self._pending)
+        active = sum(1 for s in self.slots if s is not None)
+        from ..models.paged import trace_count
+
+        return {
+            "steps": self.step_count,
+            "active_seqs": active,
+            "queued": queued,
+            "free_pages": self.allocator.free_count,
+            "total_pages": self.allocator.total,
+            "completed": self.completed,
+            "shed": self.shed,
+            "cancelled": self.cancelled_count,
+            "decode_traces": trace_count("decode"),
+            "prefill_traces": trace_count("prefill"),
+            "mode": self.config.mode,
+        }
+
+    def warmup(self) -> None:
+        """Compile the decode program and every prefill bucket up front
+        (one dummy sequence per bucket) so serving traffic never pays a
+        trace."""
+        # max_new_tokens=2: the first token comes from PREFILL — the
+        # decode program only compiles once a second token is needed.
+        probe = self.submit([1], max_new_tokens=2)
+        for _ in probe:
+            pass
+        for bucket in self.config.prefill_buckets()[1:]:
+            n = min(bucket, self.config.max_prompt_len)
+            s = self.submit(np.ones((n,), np.int32), max_new_tokens=1)
+            for _ in s:
+                pass
+
+    # ---------------------------------------------------------------- loop
+
+    def _bucket_len(self, n: int) -> int:
+        for b in self.config.prefill_buckets():
+            if b >= n:
+                return b
+        return self.config.prefill_buckets()[-1]
+
+    def _admit_locked(self) -> List[_Request]:
+        """Move queued requests into free slots (called under the lock).
+        Continuous mode admits whenever a slot AND pages are free;
+        whole-request mode admits a full gang only into an EMPTY batch."""
+        admitted: List[_Request] = []
+        whole = self.config.mode == "whole_request"
+        if whole and any(s is not None for s in self.slots):
+            return admitted
+        for slot in range(self.config.batch_slots):
+            if self.slots[slot] is not None or not self._pending:
+                continue
+            req = self._pending[0]
+            need = math.ceil((req.prompt.size + req.max_new)
+                             / self.config.page_size)
+            pages = self.allocator.alloc(need)
+            if pages is None:
+                break  # pool pressure: leave queued, retry next step
+            self._pending.pop(0)
+            req.pages = pages
+            pt = np.full((self.maxp,), self.scratch, np.int32)
+            pt[:need] = pages
+            req.page_table = pt
+            req.slot = slot
+            self.slots[slot] = req
+            admitted.append(req)
+        if admitted:
+            self._m_queue.set(len(self._pending), tags=self._pid_tags)
+        return admitted
+
+    def _evict(self, slot: int, reason: str) -> None:
+        req = self.slots[slot]
+        assert req is not None
+        self.allocator.free(req.pages)
+        req.pages = []
+        req.finished = True
+        self.slots[slot] = None
+        self._page_tables[slot, :] = self.scratch
+        self._seq_lens[slot] = 0
+        self._tokens[slot] = 0
+        self._active[slot] = False
+        self._temps[slot] = 0.0
+        self._dirty = True
+        if reason == "cancelled":
+            self.cancelled_count += 1
+            self._m_cancelled.inc(1)
+        elif reason in ("complete", "stop"):
+            self.completed += 1
+            self._m_completed.inc(1)
+        if reason == "shutdown":
+            # Loudly: a truncated generation must not look complete.
+            req.out_q.put(("err", RuntimeError(
+                "engine shut down mid-generation"), self.step_count))
+        else:
+            req.out_q.put(("done", reason, self.step_count))
+
+    def _prefill(self, req: _Request) -> None:
+        """Run one admitted sequence's prompt through the bucketed
+        prefill program and emit its first token (TTFT point)."""
+        import jax.numpy as jnp
+
+        from ..models.paged import paged_prefill
+
+        n = req.prompt.size
+        s_pad = self._bucket_len(n)
+        toks = np.zeros((1, s_pad), np.int32)
+        toks[0, :n] = req.prompt
+        first, self._d_key, self.pools = paged_prefill(
+            self.model_config, self.params, self.pools,
+            jnp.asarray(toks), jnp.asarray(n, jnp.int32),
+            jnp.asarray(req.page_table),
+            jnp.asarray(req.temperature, jnp.float32), self._d_key)
+        first = int(first)
+        now = time.perf_counter()
+        req.length = n
+        req.first_token_t = now
+        req.last_token_t = now
+        self._m_prefill.inc(n)
+        self._m_ttft.observe(now - req.submit_t)
+        slot = req.slot
+        self._page_tables[slot] = req.page_table
+        self._seq_lens[slot] = n
+        self._tokens[slot] = first
+        self._active[slot] = True
+        self._temps[slot] = req.temperature
+        self._dirty = True
+        self._emit_token(req, first)
+
+    def _emit_token(self, req: _Request, token: int) -> None:
+        req.generated += 1
+        self._m_tokens.inc(1)
+        req.out_q.put(("tok", token, self.step_count))
+        if req.stop_token is not None and token == req.stop_token:
+            self._evict(req.slot, "stop")
+        elif req.generated >= req.max_new:
+            self._evict(req.slot, "complete")
+
+    def _fail_inflight(self, exc: BaseException) -> None:
+        """A model-call failure must not kill the loop thread silently:
+        every in-flight request gets the error on its stream, pages
+        return to the free list, and the pools are rebuilt (a failed
+        donated call may have invalidated them).  Queued requests stay
+        queued — they retry against the fresh pool."""
+        from ..models.paged import init_paged_pools
+
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self.allocator.free(req.pages)
+            req.pages = []
+            req.finished = True
+            self.slots[slot] = None
+            req.out_q.put(("err", exc, self.step_count))
+        self._page_tables[:] = self.scratch
+        self._seq_lens[:] = 0
+        self._tokens[:] = 0
+        self._active[:] = False
+        self._temps[:] = 0.0
+        self._dirty = True
+        self.pools = init_paged_pools(
+            self.model_config, self.config.pool_pages,
+            self.config.page_size)
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    break
+                # Reap cancellations first: queued cancels just drop,
+                # in-flight cancels free pages before admission looks at
+                # the pool.
+                keep = []
+                for r in self._pending:
+                    if r.cancelled.is_set():
+                        self.cancelled_count += 1
+                        self._m_cancelled.inc(1)
+                        r.out_q.put(("done", "cancelled", self.step_count))
+                    else:
+                        keep.append(r)
+                if len(keep) != len(self._pending):
+                    self._m_queue.set(len(keep), tags=self._pid_tags)
+                self._pending = keep
+                for slot, req in enumerate(self.slots):
+                    if req is not None and req.cancelled.is_set():
+                        self._evict(slot, "cancelled")
+                admitted = self._admit_locked()
+                active = sum(1 for s in self.slots if s is not None)
+                if not admitted and active == 0:
+                    self._m_active.set(0, tags=self._pid_tags)
+                    self._m_pages.set(self.allocator.used_count,
+                                      tags=self._pid_tags)
+                    self._wake.wait(timeout=0.05)
+                    continue
+            # Model work runs OUTSIDE the lock: pools/slot arrays belong
+            # to this thread; submit() only appends to the wait queue.
+            try:
+                self._run_step(admitted)
+            except Exception as e:  # noqa: BLE001 — fail streams, not
+                self._fail_inflight(e)  # the loop thread
+        # Shutdown: fail queued + in-flight requests loudly.
+        with self._lock:
+            pending, self._pending = self._pending, []
+            self._m_queue.set(0, tags=self._pid_tags)
+        for req in pending:
+            req.out_q.put(("err", RuntimeError(
+                "engine shut down before admission"), self.step_count))
+        for slot, req in enumerate(self.slots):
+            if req is not None:
+                self._evict(slot, "shutdown")
+
+    def _run_step(self, admitted: List[_Request]) -> None:
+        import jax.numpy as jnp
+
+        from ..models.paged import paged_decode_step
+
+        for req in admitted:
+            self._prefill(req)
+        if not any(s is not None for s in self.slots):
+            return
+        self.step_count += 1
+        if self._dirty:
+            # Membership changed since the last step: re-upload the
+            # host mirrors.  Steady-state decode skips this — tokens,
+            # lengths, and the PRNG key advance on device.
+            self._d_tokens = jnp.asarray(self._tokens)
+            self._d_page_tables = jnp.asarray(self._page_tables)
+            self._d_seq_lens = jnp.asarray(self._seq_lens)
+            self._d_active = jnp.asarray(self._active)
+            self._d_temps = jnp.asarray(self._temps)
+            self._dirty = False
+        (self._d_tokens, self._d_seq_lens, self._d_key,
+         self.pools) = paged_decode_step(
+            self.model_config, self.params, self.pools,
+            self._d_tokens, self._d_page_tables, self._d_seq_lens,
+            self._d_active, self._d_temps, self._d_key)
+        toks = np.asarray(self._d_tokens)
+        now = time.perf_counter()
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self._seq_lens[slot] += 1
+            req.length += 1
+            self._tokens[slot] = toks[slot]
+            if req.last_token_t is not None:
+                itl = now - req.last_token_t
+                req.itls.append(itl)
+                self._m_itl.observe(itl)
+            req.last_token_t = now
+            self._emit_token(req, int(toks[slot]))
+        self._m_active.set(
+            sum(1 for s in self.slots if s is not None),
+            tags=self._pid_tags)
+        self._m_pages.set(self.allocator.used_count,
+                          tags=self._pid_tags)
+
+
+# ------------------------------------------------------------ serve binding
+
+
+_MODEL_BUILDERS = {
+    "tiny": lambda: _tiny_config(),
+    "b1": lambda: _b1_config(),
+}
+
+
+def _tiny_config():
+    import jax.numpy as jnp
+
+    from ..models import LlamaConfig
+
+    return LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+
+
+def _b1_config():
+    import jax.numpy as jnp
+
+    from ..models import LlamaConfig
+
+    return LlamaConfig.b1(remat=False, dtype=jnp.bfloat16)
+
+
+class LLMServer:
+    """The deployment callable: one engine per replica, tokens streamed
+    through serve's per-item streaming path (handle iterators, HTTP SSE,
+    gRPC server-streaming).  A consumer that disconnects mid-stream
+    closes the generator, which cancels the request and frees its pages."""
+
+    def __init__(self, model: str = "tiny",
+                 engine: Optional[dict] = None, seed: int = 0,
+                 warmup: bool = False):
+        import jax
+
+        from ..models import llama_init
+
+        cfg = _MODEL_BUILDERS[model]()
+        params = llama_init(cfg, jax.random.PRNGKey(seed))
+        self.engine = InferenceEngine(
+            cfg, params, EngineConfig(**(engine or {})), seed=seed)
+        if warmup:
+            self.engine.warmup()
+
+    def __call__(self, prompt_tokens, max_new_tokens: int = 16,
+                 temperature: float = 0.0,
+                 stop_token: Optional[int] = None):
+        stream = self.engine.submit(
+            prompt_tokens, max_new_tokens=max_new_tokens,
+            temperature=temperature, stop_token=stop_token)
+        try:
+            for tok in stream:
+                yield tok
+        finally:
+            # Reached on completion AND on GeneratorExit (client gone,
+            # task cancelled): idempotent, frees pages mid-flight.
+            stream.cancel()
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+
+def llm_app(model: str = "tiny", engine: Optional[dict] = None,
+            num_replicas: int = 1, name: str = "llm", seed: int = 0,
+            warmup: bool = False):
+    """Build a servable LLM application:
+    ``serve.run(llm_app(...))`` then stream tokens via
+    ``handle.options(stream=True).remote([1, 2, 3], 16)`` or POST with
+    ``Accept: text/event-stream``."""
+    from .api import Deployment
+
+    dep = Deployment(LLMServer, name, num_replicas=num_replicas)
+    return dep.bind(model=model, engine=engine, seed=seed, warmup=warmup)

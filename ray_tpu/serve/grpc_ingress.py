@@ -116,16 +116,27 @@ class _GrpcIngress:
             item; the rest waits in the object store), so a slow client
             applies backpressure to this worker thread only."""
             req, h = _route(request, context, stream=True)
+            stream = None
+            completed = False
             try:
                 stream = h.remote(
                     *(req.get("args") or []), **(req.get("kwargs") or {}))
                 for item in stream:
                     if not context.is_active():
-                        return  # client cancelled: stop consuming
+                        return  # client cancelled between frames
                     yield json.dumps({"item": item}).encode()
                 yield json.dumps({"done": True}).encode()
+                completed = True
             except Exception as e:  # noqa: BLE001 — mapped to a status
                 _abort_for(e, context)
+            finally:
+                # Any non-complete exit — the is_active() poll, a client
+                # cancellation surfacing AT the yield (grpc closes this
+                # generator: GeneratorExit, a BaseException), or an abort
+                # — cancels the replica-side generator so an
+                # engine-backed deployment frees its KV pages mid-flight.
+                if stream is not None and not completed:
+                    stream.cancel()
 
         class Handler(grpc.GenericRpcHandler):
             def service(self, details):

@@ -94,6 +94,18 @@ class DeploymentResponseGenerator:
             cb, self._done_cb = self._done_cb, None
             cb()
 
+    def cancel(self) -> None:
+        """Stop the replica-side generator (reference: serve's streaming
+        requests cancel the underlying task when the client disconnects).
+        The replica raises TaskCancelledError inside the user generator,
+        so engine-backed deployments free pages mid-flight.  Idempotent;
+        also releases this handle's outstanding-load count."""
+        try:
+            self._gen.cancel()
+        except Exception:  # noqa: BLE001 — cancel must never raise at
+            pass           # teardown (task may already be finished)
+        self._release()
+
     def __iter__(self):
         from ..exceptions import ActorDiedError, WorkerCrashedError
 

@@ -48,6 +48,17 @@ BUILTIN_METRICS: Dict[str, str] = {
     "ray_tpu_serve_batch_size": "histogram",
     "ray_tpu_serve_batch_queue_depth": "gauge",
     "ray_tpu_serve_replica_retries_total": "counter",
+    # LLM inference engine (serve/engine.py)
+    "ray_tpu_gen_tokens_total": "counter",
+    "ray_tpu_gen_prefill_tokens_total": "counter",
+    "ray_tpu_gen_kv_pages_in_use": "gauge",
+    "ray_tpu_serve_engine_queue_depth": "gauge",
+    "ray_tpu_serve_engine_active_seqs": "gauge",
+    "ray_tpu_serve_engine_shed_total": "counter",
+    "ray_tpu_serve_engine_completed_total": "counter",
+    "ray_tpu_serve_engine_cancelled_total": "counter",
+    "ray_tpu_serve_engine_ttft_seconds": "histogram",
+    "ray_tpu_serve_engine_itl_seconds": "histogram",
     # data (data/dataset.py)
     "ray_tpu_data_rows_total": "counter",
     "ray_tpu_data_stage_seconds_total": "counter",

@@ -30,17 +30,9 @@ class _QueueActor:
 
         self._q: "asyncio.Queue" = asyncio.Queue(maxsize)
 
-    async def put(self, item, timeout: Optional[float] = None) -> bool:
-        import asyncio
-
-        try:
-            if timeout is None:
-                await self._q.put(item)
-            else:
-                await asyncio.wait_for(self._q.put(item), timeout)
-            return True
-        except asyncio.TimeoutError:
-            return False
+    # NOTE: no actor-side timed `put`: asyncio.wait_for(self._q.put(...))
+    # cancellation RACES a successful insert — the caller would see Full
+    # with the item actually enqueued.  Clients probe with put_nowait.
 
     async def get(self, timeout: Optional[float] = None):
         import asyncio
@@ -125,24 +117,25 @@ class Queue:
 
     def put(self, item, block: bool = True,
             timeout: Optional[float] = None) -> None:
+        # put is NOT idempotent, so BOTH blocking paths probe with
+        # put_nowait instead of a timed actor-side put: an actor-side
+        # asyncio.wait_for(self._q.put(item)) whose cancellation races a
+        # successful insert would make the client raise Full with the
+        # item actually enqueued (phantom insert), and retrying a
+        # timed-out put could double-insert if the first landed late.
         if not block:
             return self.put_nowait(item)
-        if timeout is None:
-            # put is NOT idempotent, so the infinite-block loop probes
-            # with put_nowait (retrying a timed-out actor-side put could
-            # double-insert if the first landed late).
-            import time
+        import time
 
-            while True:
-                ok = ray_tpu.get(self._actor.put_nowait.remote(item),
-                                 timeout=60)
-                if ok:
-                    return
-                time.sleep(0.05)
-        ok = ray_tpu.get(self._actor.put.remote(item, timeout),
-                         timeout=timeout + 30)
-        if not ok:
-            raise Full(f"queue full after {timeout}s")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok = ray_tpu.get(self._actor.put_nowait.remote(item),
+                             timeout=60)
+            if ok:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full(f"queue full after {timeout}s")
+            time.sleep(0.05)
 
     def get(self, block: bool = True,
             timeout: Optional[float] = None) -> Any:

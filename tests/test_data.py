@@ -664,6 +664,70 @@ def test_hash_join_inner_and_left(rt):
     assert "HashJoin" in left.join(right, on="k").explain()
 
 
+def test_join_key_digest_large_int_float_equal(rt):
+    """Keys >= 2**53 that compare equal under python == (int vs float)
+    must digest identically, or hash partitioning silently drops matches
+    that num_partitions=1 would find."""
+    from ray_tpu.data.dataset import _join_key_digestable as dig
+
+    for v in (2 ** 53, 2 ** 60, -(2 ** 58)):
+        assert dig(v) == dig(float(v)), v
+        assert dig(np.int64(v) if abs(v) < 2 ** 62 else v) == dig(float(v))
+    # Small values keep the legacy canonical form; non-equal values keep
+    # distinct digests.
+    assert dig(2) == dig(2.0)
+    assert dig(2 ** 53) != dig(2 ** 53 + 1)  # no float equals 2**53+1
+    assert dig(True) != dig(1.0)  # bools stay bools
+    assert dig(float(2 ** 53) + 2.0) == dig(2 ** 53 + 2)
+
+    # End to end: a large int key on the left matching an equal-valued
+    # float key on the right must join at ANY partition count.
+    big = 2 ** 53
+    left = rtd.from_items(
+        [{"k": big, "v": 1}, {"k": 7, "v": 2}], override_num_blocks=2)
+    right = rtd.from_items(
+        [{"k": float(big), "w": 10.0}, {"k": 7.0, "w": 70.0}],
+        override_num_blocks=2)
+    rows = sorted(left.join(right, on="k").take_all(),
+                  key=lambda r: r["v"])
+    assert [(r["v"], r["w"]) for r in rows] == [(1, 10.0), (2, 70.0)]
+
+
+def test_stats_reports_last_materialize_without_reexecution(rt):
+    """materialize() collects per-operator timings opportunistically;
+    a following stats() reports THAT run instead of re-executing the
+    plan (side-effecting UDFs must not run twice)."""
+    import os
+    import tempfile
+
+    calls_file = os.path.join(tempfile.mkdtemp(), "calls")
+
+    def effectful(batch):
+        with open(calls_file, "a") as f:
+            f.write("x")
+        batch["id"] = batch["id"] * 2
+        return batch
+
+    ds = rtd.range(24, override_num_blocks=3).map_batches(effectful)
+    mat = ds.materialize()
+    n_after_mat = os.path.getsize(calls_file)
+    assert n_after_mat == 3  # one call per block
+
+    for d in (ds, mat):
+        st = d.stats()
+        assert st["operators_source"] == "last_materialize"
+    ops = {o["operator"]: o for o in ds.stats()["operators"]}
+    assert ops["MapBatches(effectful)"]["rows_out"] == 24
+    assert ops["MapBatches(effectful)"]["tasks"] == 3
+    # The UDF did NOT run again for any of the three stats() calls.
+    assert os.path.getsize(calls_file) == n_after_mat
+
+    # A plan that never materialized still profiles (documented loudly).
+    ds2 = rtd.range(8, override_num_blocks=2).map_batches(effectful)
+    st2 = ds2.stats()
+    assert st2["operators_source"] == "profiled_pass"
+
+
 def test_hash_join_empty_right_partitions(rt):
     """A partition with left rows but NO right rows must still emit the
     right-side columns (NaN/None-filled), keeping blocks schema-consistent

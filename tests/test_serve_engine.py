@@ -1,0 +1,322 @@
+"""Continuous-batching LLM engine tests: paged cache parity, per-step
+admission, page lifecycle, admission control, compile stability, and the
+serve streaming/cancellation integration.
+
+Reference analog: vLLM-style engine tests + serve/tests/test_streaming —
+the decode loop admits BETWEEN steps, pages free-list balances after any
+workload, and one compiled program serves every admission mix.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+# Shared engine geometry: every engine below compiles the SAME decode
+# shape (slots x page-table width), so the per-process jit cache is hit
+# across tests and the compile-count assertions stay meaningful.
+GEOMETRY = dict(batch_slots=4, page_size=8, max_prompt_len=16,
+                max_new_tokens_cap=32)
+
+
+def _tiny_engine(**overrides):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+    cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    kw = dict(GEOMETRY, max_queue=16)
+    kw.update(overrides)
+    return InferenceEngine(cfg, params, EngineConfig(**kw), seed=0)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = _tiny_engine()
+    eng.warmup()  # compile decode + every prefill bucket up front
+    yield eng
+    eng.shutdown()
+
+
+def test_paged_decode_matches_reference_generate(engine):
+    """The paged engine's greedy decode must match models.generate token
+    for token (same params, same math, pages instead of a linear cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.generate import generate
+
+    prompt = [5, 7, 11]
+    toks = list(engine.submit(prompt, max_new_tokens=6))
+    ref = np.asarray(generate(
+        engine.model_config, engine.params,
+        np.asarray([prompt], np.int32), max_new_tokens=6))[0, len(prompt):]
+    assert toks == ref.tolist()
+    # Greedy decode is deterministic across engine runs.
+    assert list(engine.submit(prompt, max_new_tokens=6)) == toks
+
+
+def test_admission_mid_stream_stalls_at_most_one_step(engine):
+    """A sequence admitted mid-stream joins the running batch between
+    decode steps: the running sequence keeps emitting one token per step
+    (its step indices stay consecutive), and the newcomer finishes long
+    before the long request — the continuous-batching property."""
+    a = engine.submit([1, 2, 3, 4], max_new_tokens=24)
+    next(a)  # A admitted and decoding
+    b = engine.submit([9, 9], max_new_tokens=4)
+    b_toks = list(b)
+    list(a)
+    assert len(b_toks) == 4
+    # A emitted one token per decode step throughout B's admission,
+    # prefill, and decode — deltas of exactly 1 mean B's prefill stalled
+    # A by at most the one inter-step gap it rode in on.
+    deltas = [y - x for x, y in zip(a.steps[1:], a.steps[2:])]
+    assert deltas and all(d == 1 for d in deltas), a.steps
+    # B ran INSIDE A's window (admitted after A started, done before A).
+    assert a.steps[0] <= b.steps[0] <= b.steps[-1] < a.steps[-1]
+
+
+def test_page_free_list_balances_after_churn(engine):
+    """Completion, cancellation, and shutdown-free paths all return pages:
+    after N churn rounds the free list must be exactly full."""
+    alloc = engine.allocator
+    for round_ in range(5):
+        streams = [engine.submit([1 + round_, 2, 3], max_new_tokens=6)
+                   for _ in range(6)]
+        cancelled = engine.submit([7, 7], max_new_tokens=32)
+        next(cancelled)
+        cancelled.cancel()
+        for s in streams:
+            assert len(list(s)) == 6
+    deadline = time.time() + 10
+    while time.time() < deadline and alloc.free_count != alloc.total:
+        time.sleep(0.05)
+    assert alloc.free_count == alloc.total
+    assert engine.stats()["cancelled"] >= 5
+
+
+def test_overload_sheds_typed_error_and_counts(engine):
+    """Admission control: a full wait queue sheds NEW arrivals with the
+    typed error, serves everything already admitted/queued, and counts
+    the sheds."""
+    from ray_tpu.serve.engine import EngineOverloadedError
+    from ray_tpu.util.metrics import get_counter
+
+    small = _tiny_engine(max_queue=2)
+    try:
+        counter = get_counter("ray_tpu_serve_engine_shed_total")
+        before_metric = sum(counter._values.values())
+        busy = []
+        for _ in range(small.config.batch_slots):
+            s = small.submit([1] * 8, max_new_tokens=32)
+            next(s)  # in a slot and decoding before the next submit
+            busy.append(s)
+        queued = [small.submit([2], max_new_tokens=1) for _ in range(2)]
+        with pytest.raises(EngineOverloadedError):
+            for _ in range(small.config.max_queue + 4):
+                small.submit([3], max_new_tokens=1)
+        for s in busy + queued:
+            assert len(list(s)) > 0  # admitted work still completes
+        assert small.stats()["shed"] >= 1
+        assert sum(counter._values.values()) > before_metric
+        assert small.allocator.free_count == small.allocator.total
+    finally:
+        small.shutdown()
+
+
+def test_one_compiled_decode_program_for_any_mix(engine):
+    """The compile-count contract: after the programs exist, no admission
+    mix (occupancy, lengths, churn, cancellation) retraces the decode
+    step — batch slots, page tables, and lengths are DATA."""
+    from ray_tpu.models.paged import trace_count
+
+    # Prior tests exercised the engine; programs exist.
+    decode_before = trace_count("decode")
+    prefill_before = trace_count("prefill")
+    assert decode_before >= 1
+    streams = [engine.submit([1], max_new_tokens=3),
+               engine.submit([2, 3, 4, 5, 6, 7, 8, 9], max_new_tokens=9),
+               engine.submit([4, 5], max_new_tokens=1)]
+    mid = engine.submit([8] * 12, max_new_tokens=5)
+    for s in streams:
+        list(s)
+    list(mid)
+    c = engine.submit([6], max_new_tokens=17)
+    next(c)
+    c.cancel()
+    assert trace_count("decode") == decode_before
+    assert trace_count("prefill") == prefill_before
+
+
+def test_prefill_bucket_wider_than_worst_case_footprint():
+    """The page table must cover the largest prefill BUCKET, not just the
+    worst-case sequence: padded prefill positions index the table, and a
+    clamped out-of-range gather would silently overwrite a real page.
+    max_prompt 20 / cap 4 / page 8 -> worst case 3 pages but bucket 32
+    needs 4 table entries."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.generate import generate
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+    cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(cfg, params, EngineConfig(
+        batch_slots=2, page_size=8, max_prompt_len=20,
+        max_new_tokens_cap=4, max_queue=4))
+    try:
+        assert eng.maxp == 4
+        prompt = list(range(2, 20))  # 18 tokens -> the 32 bucket
+        toks = list(eng.submit(prompt, max_new_tokens=4))
+        ref = np.asarray(generate(
+            cfg, params, np.asarray([prompt], np.int32),
+            max_new_tokens=4))[0, len(prompt):]
+        assert toks == ref.tolist()
+        assert eng.allocator.free_count == eng.allocator.total
+    finally:
+        eng.shutdown()
+
+
+def test_whole_request_mode_gang_admission():
+    """The baseline mode admits only into an EMPTY batch: a request
+    arriving mid-gang waits for the gang to fully drain."""
+    eng = _tiny_engine(mode="whole_request")
+    try:
+        a = eng.submit([1, 2], max_new_tokens=12)
+        next(a)
+        b = eng.submit([3, 4], max_new_tokens=2)
+        b_toks = list(b)
+        list(a)
+        assert len(b_toks) == 2
+        # B's first token comes only after A's last step (gang barrier) —
+        # the exact opposite of the continuous-mode assertion above.
+        assert b.steps[0] >= a.steps[-1]
+    finally:
+        eng.shutdown()
+
+
+def test_model_failure_fails_streams_not_the_loop(monkeypatch):
+    """A model-call failure mid-decode surfaces on the affected streams
+    (not silent stalls), pages return, the pool is rebuilt, and the loop
+    keeps serving; shutdown mid-generation errors instead of truncating."""
+    import ray_tpu.models.paged as paged_mod
+
+    eng = _tiny_engine()
+    try:
+        assert len(list(eng.submit([1, 2, 3], max_new_tokens=4))) == 4
+        real = paged_mod.paged_decode_step
+        calls = {"n": 0}
+
+        def boom(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected device failure")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(paged_mod, "paged_decode_step", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            list(eng.submit([4, 5], max_new_tokens=6))
+        # Recovered: fresh pool, balanced free list, still serving.
+        assert len(list(eng.submit([1, 2, 3], max_new_tokens=4))) == 4
+        assert eng.allocator.free_count == eng.allocator.total
+    finally:
+        eng.shutdown()
+
+    eng2 = _tiny_engine()
+    s = eng2.submit([1], max_new_tokens=16)
+    next(s)
+    eng2.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        list(s)
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_llm_app_streams_and_cancels_through_serve(rt):
+    """The engine behind the full serve stack: handle streaming, SSE
+    ingress, and a mid-stream handle cancel that frees the replica's
+    pages (the decode loop sees the consumer vanish)."""
+    handle = serve.run(serve.llm_app(
+        engine=dict(GEOMETRY, max_queue=8), name="llm"))
+
+    toks = list(handle.options(stream=True).remote([5, 7, 11], 5))
+    assert len(toks) == 5 and all(isinstance(t, int) for t in toks)
+    assert list(handle.options(stream=True).remote([5, 7, 11], 5)) == toks
+
+    port = serve.start_http()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/llm",
+            data=json.dumps({"prompt_tokens": [5, 7, 11],
+                             "max_new_tokens": 3}).encode(),
+            headers={"Accept": "text/event-stream"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            frames = [json.loads(ln[5:])
+                      for ln in resp.read().decode().splitlines()
+                      if ln.startswith("data:")
+                      and ln[5:].strip() != "null"]
+        assert frames == toks[:3]
+    finally:
+        serve.stop_http()
+
+    # Mid-stream cancel: the replica-side generator is closed, the
+    # engine evicts the sequence, and every page returns to the pool.
+    stream = handle.options(stream=True).remote([1, 2], 32)
+    it = iter(stream)
+    next(it), next(it)
+    stream.cancel()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        st = handle.options("stats").remote().result()
+        if st["free_pages"] == st["total_pages"] and not st["active_seqs"]:
+            break
+        time.sleep(0.2)
+    assert st["free_pages"] == st["total_pages"], st
+    assert st["cancelled"] >= 1
+    # One compiled decode program served the whole test.
+    assert st["decode_traces"] == 1
+
+
+@pytest.mark.slow
+def test_bench_serve_smoke():
+    """The traffic generator and BOTH batching modes stay exercised: the
+    bench's smoke mode must produce a full summary with balanced free
+    lists and single-compile decode rows."""
+    import os
+    import tempfile
+
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_serve.py")
+    with tempfile.NamedTemporaryFile(suffix=".json") as f:
+        subprocess.run(
+            [sys.executable, bench, "--smoke", "--out", f.name],
+            check=True, timeout=540, cwd=os.path.dirname(bench),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        report = json.load(open(f.name))
+    s = report["summary"]
+    assert s["continuous_tokens_per_s"] > 0
+    assert s["whole_request_tokens_per_s"] > 0
+    assert "continuous_over_whole_request" in s
+    for rows in report["modes"].values():
+        assert rows and all(r["free_list_balanced"] for r in rows)
+        assert all(r["decode_traces"] == 1 for r in rows)

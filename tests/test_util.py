@@ -103,6 +103,38 @@ def test_queue_blocking_and_batches(rt):
     q.shutdown()
 
 
+def test_queue_timed_put_no_phantom_insert(rt):
+    """A timed put that times out must NOT have inserted the item: the
+    old actor-side asyncio.wait_for path could cancel a put whose insert
+    already landed (phantom insert) — the probe-loop path can't, because
+    put_nowait either inserts and returns True or doesn't insert at all."""
+    q = Queue(maxsize=1)
+    q.put("only")
+    t0 = time.monotonic()
+    with pytest.raises(Full):
+        q.put("spill", timeout=0.5)
+    assert 0.4 <= time.monotonic() - t0 < 10
+    # The queue holds EXACTLY the first item: the timed-out put left no
+    # phantom behind it.
+    assert q.qsize() == 1
+    assert q.get_nowait() == "only"
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+    # A timed put that finds room within the window succeeds.
+    q.put("a")
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(q.put("b", timeout=10)), daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert q.get(timeout=5) == "a"
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert q.get(timeout=5) == "b"
+    q.shutdown()
+
+
 def test_queue_shared_across_tasks(rt):
     """The handle pickles: producer and consumer tasks share one queue."""
     q = Queue(maxsize=16)
