@@ -39,6 +39,145 @@ class NodeHandle:
         return self.node_id.hex()
 
 
+class ExternalHead:
+    """A head daemon in its OWN process (``ray_tpu.core.head_main``),
+    supervised: spawn, wait-ready, SIGKILL, restart — the process shape the
+    head-kill chaos harness needs (a driver-hosted head cannot be killed
+    without killing the workload).  The spawn env pins the three identities
+    a restart must preserve: port (``RT_HEAD_PORT``), session
+    (``RT_HEAD_SESSION``), and local node id (``RT_NODE_ID``); pass
+    ``state_path`` to make the durable tables survive too."""
+
+    def __init__(
+        self,
+        state_path: Optional[str] = None,
+        num_cpus: int = 4,
+        num_workers: Optional[int] = None,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        import socket as _socket
+
+        self.session = f"xhead-{os.urandom(4).hex()}"
+        self.node_id = NodeID.from_random()
+        self.state_path = state_path
+        # Reserve a port up front: the head must rebind the SAME one after
+        # a kill, and the reconnecting field already holds the address.
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        self.port = s.getsockname()[1]
+        s.close()
+        self.addr = f"127.0.0.1:{self.port}"
+        self._extra_env = dict(env or {})
+        self._num_cpus = num_cpus
+        self._num_workers = num_workers
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.start()
+
+    def _spawn_env(self) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.pop("RT_ADDRESS", None)
+        for k in list(env):
+            if k.startswith(("PALLAS_AXON", "TPU_", "AXON_")):
+                env.pop(k)
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else pkg_parent
+        )
+        env.update(
+            RT_HEAD_PORT=str(self.port),
+            RT_HEAD_SESSION=self.session,
+            RT_NODE_ID=self.node_id.hex(),
+            RT_NODE_RESOURCES=json.dumps(
+                {"CPU": float(self._num_cpus), "memory": float(2**33)}),
+            RT_NODE_NUM_WORKERS=str(
+                self._num_workers if self._num_workers is not None
+                else self._num_cpus),
+            JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+        )
+        if self.state_path:
+            env["RT_HEAD_STATE_PATH"] = self.state_path
+        env.update(self._extra_env)
+        return env
+
+    def start(self, timeout: float = 60.0):
+        from ray_tpu.core.node_main import LOG_ROOT
+
+        log_dir = os.path.join(LOG_ROOT, self.session)
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(
+            log_dir, f"head-{self.restarts}-{time.time_ns()}.log")
+        logf = open(log_path, "wb")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.head_main"],
+            env=self._spawn_env(),
+            stdout=logf, stderr=subprocess.STDOUT,
+        )
+        logf.close()
+        self._log_path = log_path
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                with open(log_path, "rb") as f:
+                    tail = f.read()[-4000:].decode(errors="replace")
+                raise RuntimeError(
+                    f"external head exited at boot (rc={self.proc.returncode}):\n{tail}")
+            try:
+                with open(log_path, "rb") as f:
+                    if b"RAY_TPU_HEAD_READY" in f.read():
+                        return self
+            except OSError:
+                pass
+            time.sleep(0.05)
+        raise TimeoutError("external head never reported ready")
+
+    def kill(self):
+        """SIGKILL — the crash being simulated.  No cleanup runs."""
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+            except ProcessLookupError:
+                pass
+            self.proc.wait(timeout=10)
+
+    def restart(self, timeout: float = 60.0):
+        """Respawn with the identical identity env (port/session/node id/
+        state path): the restarted head restores its durable snapshot and
+        waits for field-state resync."""
+        self.restarts += 1
+        return self.start(timeout=timeout)
+
+    def shutdown(self):
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.terminate()
+                self.proc.wait(timeout=10)
+            except Exception:
+                try:
+                    self.proc.kill()
+                    self.proc.wait(timeout=5)
+                except Exception:
+                    pass
+        # Sweep the head-node session's segments (a killed head never
+        # cleaned /dev/shm).
+        import glob
+
+        for path in glob.glob(f"/dev/shm/rtpu-{self.session}-*") + glob.glob(
+            f"/dev/shm/rtpu-pool-{self.session}/*"
+        ):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        try:
+            os.rmdir(f"/dev/shm/rtpu-pool-{self.session}")
+        except OSError:
+            pass
+
+
 class Cluster:
     def __init__(
         self,

@@ -32,6 +32,11 @@ IDEMPOTENT_METHODS = frozenset({
     "available_resources", "store_stats", "object_sizes", "ping",
     "get_actor_by_name", "list_named_actors", "health_ack", "get_log",
     "resolve_actor",
+    # Blocking reads: safe to re-issue after a head restart — the restarted
+    # head re-learns objects from field-state resync and the re-issued wait
+    # blocks until the reseal, giving head-routed gets a bounded pause
+    # instead of a hard failure across the restart window.
+    "get_objects", "wait_objects",
 })
 #: attempts / base delay for the jittered exponential backoff below.
 IDEMPOTENT_RETRY_ATTEMPTS = 3
@@ -57,6 +62,10 @@ class Client:
         "rpc": "reconnect swaps in a fresh RpcClient with one reference "
                "store; racing readers use the dying client once more and "
                "retry through call()'s idempotent-retry path",
+        "reconnect_refused": "monotonic None->reason publication from the "
+                             "reconnect path (under _reconnect_lock); the "
+                             "worker's reconnect thread polls it and a "
+                             "stale None read just retries once more",
     }
 
     def __init__(
@@ -75,6 +84,25 @@ class Client:
         self.head_addr = head_addr
         host, port = head_addr.rsplit(":", 1)
         self.rpc = RpcClient(host, int(port), name=f"{kind}-rpc")
+        # Re-registration identity for head-restart reconnects: the SAME
+        # worker identity must be adopted by the restarted head (field-state
+        # resync), so the original register body's fields are retained.
+        self._reg_info: Dict[str, Any] = {
+            "kind": kind, "pid": pid, "worker_id": worker_id,
+            "node_id": node_id, "log_path": log_path, "peer_addr": peer_addr,
+        }
+        # Populated by the owner process (worker_main) with a callable
+        # returning the live field state (hosted actor + incarnation) to
+        # carry on a reconnect register; None for drivers.
+        self.resync_payload = None
+        # Reconnect outcome channel for the owner's reconnect loop: set to a
+        # reason string when the head explicitly refused to adopt this
+        # process (stale incarnation, dead actor) — retrying is pointless
+        # and the process should exit.
+        self.reconnect_refused: Optional[str] = None
+        # Post-reconnect hook (owner-installed): replay buffered reports,
+        # re-arm process-level state.  Runs after the swap, outside locks.
+        self.on_reconnected = None
         body: Dict[str, Any] = {
             "kind": kind, "pid": pid,
             "protocol": wire_schema.PROTOCOL_VERSION,
@@ -143,10 +171,12 @@ class Client:
         self._submit_batch_lock = make_lock("client.submit_batch")
         # Function-table keys this process has already exported (api._export).
         self.exported_keys: set = set()
-        # Object ids of large (shm) objects this process put: their frees
-        # flush immediately instead of batching, so multi-MiB segments return
-        # to the store's warm pool promptly rather than forcing spills.
-        self.large_oids: set = set()
+        # Large (shm) objects this process put, raw id -> size: their frees
+        # flush immediately instead of batching (so multi-MiB segments return
+        # to the store's warm pool promptly), and a driver reconnecting to a
+        # RESTARTED head re-registers them from this map so the rebuilt
+        # object directory can answer for its puts.
+        self.large_oids: Dict[bytes, int] = {}
         self._last_large_free = 0.0
         self._sub_handlers: Dict[str, List[Callable]] = {}
         self._sub_lock = make_lock("client.pubsub")
@@ -306,8 +336,13 @@ class Client:
     def _flush_put_batch(self):
         """Send buffered inline-object registrations as one RPC.  Flushed
         before ANY other outbound call so no message that could reference a
-        buffered object ever overtakes its registration."""
+        buffered object ever overtakes its registration.  While headless
+        (lost head connection, reconnect pending) the batch stays buffered:
+        registrations queue and replay after re-register instead of being
+        dropped into a dead socket."""
         with self._put_batch_lock:
+            if self.rpc.closed:
+                return
             batch, self._put_batch = self._put_batch, []
         if batch:
             self._call_bg_raw("put_object_batch", {"objects": batch})
@@ -326,6 +361,11 @@ class Client:
 
     def _flush_submit_batch(self):
         with self._submit_batch_lock:
+            # Headless: hold the batch (task_done reports, submissions) for
+            # replay after reconnect — a worker finishing tasks during a
+            # head restart must not lose its completion reports.
+            if self.rpc.closed:
+                return
             batch, self._submit_batch = self._submit_batch, []
         if batch:
             self._call_bg_raw("batch", {"entries": batch})
@@ -470,12 +510,17 @@ class Client:
             buf = self.store().create(oid, size, wait_pool_s=wait)
             serialization.pack_into(meta, buffers, buf)
             with self._local_lock:
-                self.large_oids.add(oid.binary())
-            self.call_bg(
-                "put_object",
-                {"object_id": oid.binary(), "size": size,
-                 "node_id": self.node_id.binary()},
-            )
+                self.large_oids[oid.binary()] = size
+            # Registration rides the put batch (same-connection FIFO keeps
+            # it ahead of any message referencing the object) — and, while
+            # headless, it queues for replay instead of vanishing into a
+            # dead socket.
+            with self._put_batch_lock:
+                self._put_batch.append(
+                    {"object_id": oid.binary(), "size": size,
+                     "node_id": self.node_id.binary()}
+                )
+            self._flush_put_batch()
         return size
 
     @contextlib.contextmanager
@@ -508,7 +553,10 @@ class Client:
         self._flush_put_batch()
         self._flush_submit_batch()
         with self._maybe_blocked():
-            reply = self.rpc.call(
+            # Through call(): get_objects is idempotent, so a head-restart
+            # window retries (with reconnects between attempts) instead of
+            # surfacing the first ConnectionLost — the bounded pause.
+            reply = self.call(
                 "get_objects",
                 {"object_ids": [o.binary() for o in object_ids], "timeout": timeout},
                 timeout=1e9 if timeout < 0 else timeout + 30,
@@ -870,7 +918,9 @@ class Client:
     def _wait_head(self, raws: List[bytes], num_returns: int,
                    timeout: float) -> set:
         with self._maybe_blocked():
-            reply = self.rpc.call(
+            # Through call(): wait_objects is idempotent — rides the
+            # head-restart retry window like get_objects.
+            reply = self.call(
                 "wait_objects",
                 {
                     "object_ids": raws,
@@ -890,9 +940,8 @@ class Client:
                 blob = self._local.pop(ObjectID(raw), None)
                 if blob is not None:
                     self._local_bytes -= len(blob)
-                if raw in self.large_oids:
+                if self.large_oids.pop(raw, None) is not None:
                     self._last_large_free = time.monotonic()
-                    self.large_oids.discard(raw)
 
     def free_objects(self, raw_ids: List[bytes]):
         self._note_frees(raw_ids)
@@ -1001,44 +1050,77 @@ class Client:
             # of casts must land after them, matching head-batch flushing).
             dp.flush_pending()
         if method not in IDEMPOTENT_METHODS:
-            return self.rpc.call(method, body, timeout=timeout)
+            try:
+                return self.rpc.call(method, body, timeout=timeout)
+            except ConnectionLost as e:
+                # A mutating call interrupted by connection loss cannot be
+                # replayed safely (the head may or may not have applied it).
+                # Heal the connection for the caller's NEXT call, then fail
+                # typed so the caller knows to resubmit this one.
+                try:
+                    self._try_reconnect()
+                except Exception:
+                    pass
+                raise exceptions.HeadRestartedError(method) from e
         # Idempotent reads survive transient connection hiccups (head busy,
         # socket reset during a head restart window) with jittered
         # exponential backoff instead of surfacing the first failure.
         # Timeouts are NOT retried: a stuck head would just multiply the
-        # caller's wait; only connection-level failures qualify.
+        # caller's wait; only connection-level failures qualify.  When the
+        # connection is genuinely DOWN (head restart window), retries —
+        # with reconnect attempts between them — continue up to the
+        # head_restart_retry_window_s budget: the bounded pause a
+        # head-routed read pays across a head restart.
         import random
 
         last: Optional[BaseException] = None
-        for attempt in range(IDEMPOTENT_RETRY_ATTEMPTS):
+        attempt = 0
+        outage_deadline: Optional[float] = None
+        while True:
             try:
                 return self.rpc.call(method, body, timeout=timeout)
             except (ConnectionLost, ConnectionError, OSError) as e:
                 if isinstance(e, TimeoutError):
                     raise
                 last = e
-                if attempt + 1 >= IDEMPOTENT_RETRY_ATTEMPTS:
-                    break
-                backoff = IDEMPOTENT_RETRY_BASE_S * (2 ** attempt)
+                attempt += 1
+                closed = bool(getattr(self.rpc, "closed", False))
+                if not closed and attempt >= IDEMPOTENT_RETRY_ATTEMPTS:
+                    raise last
+                if closed:
+                    if outage_deadline is None:
+                        outage_deadline = time.monotonic() + \
+                            get_config().head_restart_retry_window_s
+                    if time.monotonic() >= outage_deadline:
+                        raise last
+                backoff = min(
+                    IDEMPOTENT_RETRY_BASE_S * (2 ** min(attempt - 1, 4)), 0.5
+                )
                 time.sleep(backoff * (0.5 + random.random()))
                 if self.rpc.closed:
                     # A dead RpcClient never heals on its own (sticky
                     # `closed`): without a fresh connection the remaining
                     # attempts would fail identically.
-                    self._try_reconnect()
-        raise last
+                    try:
+                        self._try_reconnect()
+                    except Exception:
+                        pass
 
     def _try_reconnect(self) -> bool:
-        """Driver-only recovery from a lost head connection (e.g. a head
-        restart window): dial a fresh RpcClient, re-register, re-subscribe
-        pubsub topics, and swap it in.  Workers never reconnect — their
-        identity (worker records, in-flight tasks) died with the old
-        connection, and worker_main exits on connection loss.  Proxy
-        drivers don't either: their mode/session state is negotiated in
-        the initial register reply, and a silent re-register could flip
-        the head's view of the protocol mid-stream."""
-        if self.kind != "driver" or self.proxy:
+        """Recovery from a lost head connection (e.g. a head restart
+        window): dial a fresh RpcClient, re-register carrying the SAME
+        identity, re-subscribe pubsub topics, and swap it in.  Drivers AND
+        workers reconnect — a worker re-register is the field-state resync
+        half of head fault tolerance (the restarted head adopts the live
+        worker, its hosted actor, and its incarnation instead of treating
+        the process as dead).  Proxy drivers don't: their mode/session
+        state is negotiated in the initial register reply, and a silent
+        re-register could flip the head's view of the protocol
+        mid-stream."""
+        if self.kind not in ("driver", "worker") or self.proxy:
             return False
+        if self.reconnect_refused is not None:
+            return False  # the head told us to stay dead; retrying is noise
         from . import schema as wire_schema
 
         # One reconnector at a time: concurrent retry paths (user thread +
@@ -1051,34 +1133,75 @@ class Client:
                 return True  # another caller already healed the connection
             return self._reconnect_locked(wire_schema)
 
+    def _reconnect_body(self, wire_schema) -> Dict[str, Any]:
+        body: Dict[str, Any] = {
+            "kind": self.kind, "pid": os.getpid(),
+            "protocol": wire_schema.PROTOCOL_VERSION,
+            # Same-process re-dial: lets the head un-retire this pid's
+            # cumulative metrics instead of double-counting them (and
+            # never confuse a recycled pid for a comeback).
+            "reconnect": True,
+        }
+        for key in ("worker_id", "node_id", "log_path", "peer_addr"):
+            val = self._reg_info.get(key)
+            if val:
+                body[key] = val
+        payload_fn = self.resync_payload
+        if payload_fn is not None:
+            try:
+                resync = payload_fn()
+            except Exception:
+                resync = None
+            if resync:
+                body["resync"] = resync
+        return body
+
     def _reconnect_locked(self, wire_schema) -> bool:
         rpc = None
         try:
             host, port = self.head_addr.rsplit(":", 1)
             rpc = RpcClient(host, int(port), name=f"{self.kind}-rpc")
+            # The fresh connection inherits EVERY push handler (execute_task
+            # / cancel / lease_revoke / pubsub / ...) BEFORE registering:
+            # the head may push work the moment the register reply is sent.
+            for name, fn in list(self.rpc._push_handlers.items()):
+                rpc.on_push(name, fn)
             rpc.on_push("pubsub", self._on_pubsub)
             rpc.on_push("object_free", self._on_object_free)
-            reply = rpc.call("register", {
-                "kind": self.kind, "pid": os.getpid(),
-                "protocol": wire_schema.PROTOCOL_VERSION,
-                # Same-process re-dial: lets the head un-retire this pid's
-                # cumulative metrics instead of double-counting them (and
-                # never confuse a recycled pid for a comeback).
-                "reconnect": True,
-            })
-            if reply.get("session") != self.session:
-                # A different session means the HEAD RESTARTED, not a
-                # network blip: this driver's puts and object refs live in
-                # the old session's store namespace and its node_id may be
-                # stale — a silent rebind would look healthy until the
-                # first object access hung.  Surface the outage instead.
+            reply = rpc.call("register", self._reconnect_body(wire_schema))
+            if reply.get("refused"):
+                # The head explicitly refused to adopt this identity (stale
+                # worker incarnation, dead actor): publish the reason so the
+                # owner's reconnect loop exits instead of retrying forever.
+                self.reconnect_refused = str(reply["refused"])
+                rpc.close()
+                return False
+            if self.kind == "driver" and reply.get("session") != self.session:
+                # A different session means a head restart LOST the store
+                # namespace this driver's puts live in (no stable
+                # RT_HEAD_SESSION): a silent rebind would look healthy until
+                # the first object access hung.  Surface the outage instead.
+                # (A standalone head restarted with the same session is
+                # indistinguishable from a network blip here — by design.)
                 rpc.close()
                 return False
             with self._sub_lock:
                 topics = list(self._sub_handlers)
             for topic in topics:
                 rpc.call("subscribe", {"topic": topic})
+            # The replacement inherits the lost-connection callback only
+            # once registration succeeded — a drop during the handshake is
+            # handled by this method's own failure path, not by spawning a
+            # second reconnect loop.  (The old client's attribute still
+            # holds the owner's callback: close() nulls it after the swap.)
+            rpc.on_connection_lost = self.rpc.on_connection_lost
         except Exception:
+            if os.environ.get("RT_DEBUG_RPC_ERR"):
+                import sys as _sys
+                import traceback as _tb
+
+                print("reconnect attempt failed:", file=_sys.stderr)
+                _tb.print_exc()
             # A dial that got as far as registering left a live duplicate
             # driver connection head-side: close it so its disconnect
             # cleanup runs NOW (against a connection that owns nothing)
@@ -1092,9 +1215,47 @@ class Client:
             return False  # head still down: the caller's backoff continues
         old, self.rpc = self.rpc, rpc
         try:
+            old.on_connection_lost = None  # its loss already happened
             old.close()  # stop the dead client's event-loop thread
         except Exception:
             pass
+        # Field-state resync, client half: a restarted head's object
+        # directory is rebuilt from live reports — re-register this
+        # process's large shm puts so its refs stay resolvable.  Rides the
+        # put batch (FIFO ahead of anything that references them); the
+        # restarted head's adopt path tolerates already-known objects, so
+        # a plain network blip just re-asserts existing records.
+        with self._local_lock:
+            large = list(self.large_oids.items())
+        if large and self.node_id is not None:
+            with self._put_batch_lock:
+                self._put_batch[:0] = [
+                    {"object_id": raw, "size": size,
+                     "node_id": self.node_id.binary()}
+                    for raw, size in large
+                ]
+        if self._dataplane is not None:
+            try:
+                # Held leases died with the old head: drop the slots (their
+                # lease ids mean nothing to the new incarnation) and
+                # re-route queued specs; cached direct-actor routes stay —
+                # the workers survived and their peer servers kept serving.
+                self._dataplane.on_head_reconnected()
+            except Exception:
+                pass
+        # Replay everything buffered during the headless window (task_done
+        # reports, submissions, object registrations).
+        try:
+            self._flush_put_batch()
+            self._flush_submit_batch()
+        except Exception:
+            pass
+        cb = self.on_reconnected
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
         # The free-flusher thread exits when it observes a closed rpc; if it
         # died during the outage window, object frees (and the batched
         # put/submit safety-net flush) would silently stop forever.  The
@@ -1111,17 +1272,21 @@ class Client:
         # Reads work again, but the OLD connection's death already tore
         # down job-scoped state head-side (non-detached placement groups,
         # in-flight task ownership).  Say so loudly instead of letting a
-        # later hang be the first symptom.
-        import warnings
+        # later hang be the first symptom.  (Workers skip the warning —
+        # their reconnect is the designed headless-recovery path and the
+        # head logs the resync.)
+        if self.kind == "driver":
+            import warnings
 
-        warnings.warn(
-            "ray_tpu driver reconnected to the head after a lost "
-            "connection; job-scoped state tied to the old connection "
-            "(non-detached placement groups, in-flight tasks) may have "
-            "been released",
-            RuntimeWarning,
-            stacklevel=3,
-        )
+            warnings.warn(
+                "ray_tpu driver reconnected to the head after a lost "
+                "connection; job-scoped state tied to the old connection "
+                "(non-detached placement groups, in-flight head-routed "
+                "tasks) may have been released — resubmit anything that "
+                "fails with HeadRestartedError",
+                RuntimeWarning,
+                stacklevel=3,
+            )
         return True
 
     def close(self):

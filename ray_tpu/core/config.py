@@ -56,6 +56,13 @@ SPAWN_ENV_CONTRACT = {
                         "driver via pubsub",
     "RT_FORCE_PROXY_DRIVER": "1 = force the off-host proxy driver path "
                              "(tests; hosts without usable /dev/shm)",
+    # -- standalone head (core/head_main.py) ----------------------------------
+    "RT_HEAD_PORT": "fixed listen port for a standalone head daemon — a "
+                    "restarted head must rebind the SAME port so headless "
+                    "nodes/workers/drivers can redial it",
+    "RT_HEAD_SESSION": "stable session name for a standalone head — a "
+                       "restart keeps the store namespace so pre-crash "
+                       "segments stay addressable",
     # -- debug switches -------------------------------------------------------
     "RT_DEBUG_PUSH": "worker-side push/exec tracing to stderr",
     "RT_DEBUG_RPC_ERR": "server-side RPC handler error dumps to stderr",
@@ -187,6 +194,28 @@ class Config:
     # the analog of GCS fault tolerance via Redis-backed tables
     # (reference: src/ray/gcs/store_client/redis_store_client.h:33).
     head_state_path: str = ""
+    # -- head fault tolerance (headless degraded mode) ------------------------
+    # How long a node daemon / worker keeps redialing a lost head before
+    # giving up and self-terminating.  While headless, in-flight tasks,
+    # direct actor calls, peer streaming, and granted leases keep running;
+    # the deadline guarantees an orphaned cluster (head never restarted)
+    # still dies instead of leaking forkserver/worker processes
+    # (reference: GCS FT — raylets reconnect with a bounded
+    # gcs_rpc_server_reconnect_timeout_s, ray_config_def.h).
+    head_reconnect_deadline_s: float = 45.0
+    # Client-side: how long idempotent head reads keep retrying (with
+    # reconnect attempts between tries) across a head restart window
+    # before surfacing the connection error — the "bounded pause" on
+    # head-routed ops while the head is down.
+    head_restart_retry_window_s: float = 20.0
+    # Head-side: after a restart, how long the head waits for field-state
+    # resync reports (workers re-registering with their live actors)
+    # before replaying unclaimed named actors from the durable snapshot —
+    # adopting a live actor must win over re-creating it fresh.  Also the
+    # window during which submissions to not-yet-reported actors park
+    # instead of failing.  Must comfortably exceed the reconnect loops'
+    # max backoff (2 s), or adoptions lose the race to driver replays.
+    head_resync_grace_s: float = 5.0
     # -- observability --------------------------------------------------------
     task_events_buffer_size: int = 100_000
     enable_timeline: bool = True

@@ -1267,6 +1267,45 @@ class Dataplane:
                 self._results.pop(raw, None)
                 self._registered.discard(raw)
 
+    def on_head_reconnected(self):
+        """The client re-registered with a (possibly restarted) head: every
+        held lease id belongs to the OLD head incarnation and means nothing
+        to the new one — drop the slots and let queued specs re-route (the
+        head path re-primes lease acquisition on the next burst).  Cached
+        direct-actor routes are kept: the hosting workers survived the head
+        outage and their peer servers kept serving, which is exactly why
+        direct calls see zero failures across a head restart.  Also clears
+        the head-registration memo — the restarted head's directory starts
+        empty, so results that cross a process boundary later must
+        re-register.
+
+        Runs from the reconnect path (user thread / free-flusher / owner
+        reconnect thread) — never on an RPC reader thread, so the head
+        re-submissions below are safe to fire inline."""
+        flush: List[_DirectCall] = []
+        with self._lock:
+            self._registered.clear()
+            for pool in self._pools.values():
+                keep: List[_Slot] = []
+                for slot in pool.slots:
+                    if slot.dead:
+                        continue
+                    if slot.in_flight == 0:
+                        self._retire_slot(slot)
+                    else:
+                        # Specs already pipelined to a live worker drain
+                        # normally (their completions come back over the
+                        # peer connection); `revoked` just stops new routing
+                        # and the last completion retires the slot.
+                        slot.revoked = True
+                        keep.append(slot)
+                pool.slots = keep
+                pool.requesting = False
+                pool.next_request = 0.0
+                flush.extend(c for c, _ in pool.pending)
+                pool.pending.clear()
+        self._submit_calls_via_head(flush)
+
     def maintain(self):
         """Background upkeep, called from the client's flusher loop:
         renew held leases, return idle ones, flush stale client-side

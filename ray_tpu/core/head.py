@@ -211,6 +211,13 @@ class Head:
         "worker_procs": "_pids_lock",
         "_zygote": "_zygote_mutex",
     }
+    _RT_UNGUARDED = {
+        "_state_dirty": "monotonic re-arm: the loop clears it before the "
+                        "off-loop dump and ONLY the failed dump sets it "
+                        "back True — a racing loop-side _mark_dirty stores "
+                        "the same value, and a lost False just means one "
+                        "redundant snapshot next tick",
+    }
 
     def __init__(self, config: Config, session: str, host: str = "127.0.0.1"):
         self.config = config
@@ -290,6 +297,20 @@ class Head:
         # name -> human-readable reason, surfaced by get_actor(name)
         # (reference: GCS actor table entries keep a death cause).
         self.named_tombstones: Dict[str, str] = {}
+        # Named actors restored from the snapshot but NOT yet re-created:
+        # replay waits out head_resync_grace_s so a surviving worker's
+        # field report can adopt the LIVE instance instead of racing a
+        # fresh duplicate (name -> create_actor body); the periodic loop
+        # replays the leftovers after the deadline.
+        self._restore_named_pending: Dict[str, dict] = {}
+        self._restore_named_deadline = 0.0
+        # Resync race absorbers (head restart): until this deadline, actor
+        # submissions for unknown actors PARK instead of failing — a
+        # reconnected driver's replayed batch may legitimately precede the
+        # hosting worker's adoption report.  Drained on adoption/replay;
+        # leftovers fail typed when the window closes.
+        self._resync_grace_until = 0.0
+        self._parked_unknown_actor_tasks: List[dict] = []
         self._spawn_pending: Dict[NodeID, int] = {}
         self._spawn_times: Dict[NodeID, deque] = {}
         # Placement groups waiting for resources to free up (reference:
@@ -605,6 +626,24 @@ class Head:
                     self.persist_state()
                 except Exception:
                     pass
+                # Deferred snapshot replay: named actors the resync grace
+                # window left unclaimed get re-created now (field reports
+                # that arrived in time adopted the live instances instead).
+                if self._restore_named_pending \
+                        and now >= self._restore_named_deadline:
+                    pending = self._restore_named_pending
+                    self._restore_named_pending = {}
+                    for name, spec in pending.items():
+                        try:
+                            await self._replay_named_actor(name, spec)
+                        except Exception:
+                            pass
+                    # Replayed actors unblock their parked submissions.
+                    await self._drain_parked_unknown_actor_tasks()
+                if self._parked_unknown_actor_tasks \
+                        and now >= self._resync_grace_until:
+                    # Window closed: whatever is still unknown fails typed.
+                    await self._drain_parked_unknown_actor_tasks(force=True)
                 # Prune exited zygote-forked workers (orphans reaped by
                 # init) so shutdown never signals a recycled pid.
                 with self._pids_lock:
@@ -877,8 +916,13 @@ class Head:
         self.store.shutdown()
 
     def add_local_node(self, resources: Dict[str, float], num_workers: int,
-                       labels: Optional[Dict[str, str]] = None) -> NodeID:
-        node_id = NodeID.from_random()
+                       labels: Optional[Dict[str, str]] = None,
+                       node_id: Optional[NodeID] = None) -> NodeID:
+        # ``node_id``: a standalone head (head_main) pins its local node id
+        # across restarts so pre-crash object locations, driver node
+        # bindings, and resync manifests keep resolving to "this node".
+        if node_id is None:
+            node_id = NodeID.from_random()
         self.scheduler.add_node(node_id, resources, labels)
         self.local_node_id = node_id
         self.node_sessions[node_id] = self.session
@@ -994,18 +1038,36 @@ class Head:
         except wire_schema.SchemaError as e:
             raise RpcError(str(e)) from None
         kind = body["kind"]
+        reconnect = bool(body.get("reconnect"))
         if kind == "worker":
             worker_id = WorkerID(body["worker_id"])
             node_id = NodeID(body["node_id"])
+            if reconnect:
+                # Field-state resync: a worker that survived a head restart
+                # (or a connection blip) re-registers carrying its live
+                # state.  Adoption may be REFUSED (stale actor incarnation,
+                # dead actor) — then nothing is registered and the worker
+                # exits.
+                refused = await self._resync_worker_check(worker_id, body)
+                if refused is not None:
+                    self._event("worker_resync_refused",
+                                worker=worker_id.hex(), reason=refused)
+                    return {"session": self.session, "refused": refused}
             w = WorkerState(worker_id, node_id, conn, body.get("pid", 0))
             w.peer_addr = body.get("peer_addr") or ""
+            old = self.workers.get(worker_id)
+            if old is not None and old.conn is not conn:
+                # The previous connection's disconnect may not have fired
+                # yet: unlink it so its eventual teardown can't kill the
+                # adopted record.
+                self.conn_to_worker.pop(old.conn.conn_id, None)
             self.workers[worker_id] = w
             self.conn_to_worker[conn.conn_id] = worker_id
             conn.meta["kind"] = "worker"
             conn.meta["reader_node"] = node_id
             self._log_register(worker_id.hex(), "worker", node_id,
                                body.get("pid", 0), body.get("log_path", ""))
-            if self._spawn_pending.get(node_id, 0) > 0:
+            if not reconnect and self._spawn_pending.get(node_id, 0) > 0:
                 self._spawn_pending[node_id] -= 1
                 times = self._spawn_times.get(node_id)
                 if times:
@@ -1013,14 +1075,32 @@ class Head:
             self.node_worker_counts[node_id] = (
                 self.node_worker_counts.get(node_id, 0) + 1
             )
+            if reconnect:
+                # Push handlers are already installed in the reconnecting
+                # process — no worker_ready handshake: go straight to
+                # service (IDLE, or ACTOR when an adoption bound an actor).
+                w.used = True
+                w.state = IDLE
+                await self._resync_worker_adopt(w, body)
+                self._note_resync("worker", worker_id.hex())
+                self._kick()
             return {"session": self.session}
         if kind == "node":
             node_id = NodeID(body["node_id"]) if body.get("node_id") else NodeID.from_random()
-            self.scheduler.add_node(node_id, body["resources"], body.get("labels"))
+            if node_id not in self.scheduler.nodes:
+                self.scheduler.add_node(
+                    node_id, body["resources"], body.get("labels"))
             self.node_sessions[node_id] = body.get("store_session", self.session)
             self.node_worker_caps[node_id] = body.get("num_workers", 4)
-            self.node_worker_counts[node_id] = 0
-            self._spawn_pending[node_id] = 0
+            if reconnect:
+                # Blip case: workers of this node may have re-registered
+                # BEFORE their daemon did — never zero a count they already
+                # rebuilt.
+                self.node_worker_counts.setdefault(node_id, 0)
+                self._spawn_pending.setdefault(node_id, 0)
+            else:
+                self.node_worker_counts[node_id] = 0
+                self._spawn_pending[node_id] = 0
             self.node_daemons[node_id] = conn
             if body.get("object_addr"):
                 self.node_object_addrs[node_id] = body["object_addr"]
@@ -1031,6 +1111,10 @@ class Head:
             conn.meta["node_id"] = node_id
             self._log_register(node_id.hex(), "node", node_id,
                                body.get("pid", 0), body.get("log_path", ""))
+            if reconnect:
+                resync = body.get("resync") or {}
+                self._note_resync("node", node_id.hex(),
+                                  headless_s=resync.get("headless_s"))
             self._kick()
             return {"session": self.session, "node_id": node_id.binary()}
         # Drivers on the head host attach its shm session for zero-copy
@@ -1064,6 +1148,116 @@ class Head:
             "session": self.session,
             "node_id": self.local_node_id.binary() if self.local_node_id else b"",
         }
+
+    # -- field-state resync (head restart survival) ---------------------------
+    # (reference: GCS FT — on a GCS restart, raylets and core workers
+    # reconnect and replay their local state so the volatile tables are
+    # rebuilt from the field; redis_store_client.h holds only the durable
+    # tables.  Here: workers re-register carrying their live actor +
+    # creation spec, node daemons replay their store manifests through
+    # put_object_batch, and drivers re-assert their large puts.)
+
+    def _note_resync(self, kind: str, proc_hex: str,
+                     headless_s: Optional[float] = None):
+        self.builtin_metrics.resync_reports.inc(tags={"kind": kind})
+        if headless_s is not None and kind == "node":
+            self.builtin_metrics.headless_seconds.set(
+                float(headless_s), tags={"node": proc_hex})
+        self._event("head_resync", peer_kind=kind, proc=proc_hex)
+
+    async def _resync_worker_check(self, worker_id: WorkerID,
+                                   body) -> Optional[str]:
+        """Decide whether a reconnecting worker's claimed state can be
+        adopted.  Returns a refusal reason, or None to adopt.  The refusal
+        cases are exactly the stale-incarnation ones: the cluster has (or
+        is creating) a NEWER incarnation of the claimed actor, so the old
+        process's state must not re-enter the directory."""
+        resync = body.get("resync") or {}
+        raw_actor = resync.get("actor_id")
+        if not raw_actor:
+            return None  # plain pooled worker: always adoptable
+        actor = self.actors.get(ActorID(raw_actor))
+        if actor is None:
+            # Unknown actor (head restarted): adoptable iff the worker
+            # shipped a usable creation spec to rebuild the record from.
+            creation = resync.get("creation_spec")
+            if not isinstance(creation, dict) or not creation.get("task_id"):
+                return "unknown_actor_without_creation_spec"
+            meta = creation.get("actor_meta") or {}
+            name = meta.get("name")
+            if name and self.named_actors.get(name) not in (None, ActorID(raw_actor)):
+                return "actor_name_taken_by_newer_incarnation"
+            return None
+        if actor.state == "DEAD":
+            return "actor_dead"
+        if actor.state in ("PENDING", "RESTARTING"):
+            # A replacement incarnation is already being created (this
+            # head watched the old connection die and started the restart):
+            # the returning process is the STALE incarnation.
+            return "stale_incarnation"
+        if actor.worker_id is not None and actor.worker_id != worker_id:
+            w = self.workers.get(actor.worker_id)
+            if w is not None and w.conn.alive:
+                return "stale_incarnation"
+        return None
+
+    async def _resync_worker_adopt(self, w: WorkerState, body) -> None:
+        """Bind a reconnecting worker's claimed live actor (check already
+        passed).  Unknown actors are rebuilt full-fidelity from the shipped
+        creation spec — field state merges with (and preempts) the durable
+        snapshot's deferred named-actor replay."""
+        resync = body.get("resync") or {}
+        raw_actor = resync.get("actor_id")
+        if not raw_actor:
+            return
+        aid = ActorID(raw_actor)
+        actor = self.actors.get(aid)
+        if actor is None:
+            creation = dict(resync.get("creation_spec") or {})
+            meta = creation.pop("actor_meta", None) or {}
+            spec = {
+                "actor_id": raw_actor,
+                "class_name": meta.get("class_name")
+                or str(creation.get("name", "")).split(".", 1)[0],
+                "name": meta.get("name"),
+                "namespace": meta.get("namespace"),
+                "max_restarts": meta.get("max_restarts", 0),
+                "max_task_retries": meta.get("max_task_retries", 0),
+                "method_names": meta.get("method_names", []),
+                "method_defaults": meta.get("method_defaults", {}),
+                "lifetime": meta.get("lifetime"),
+                "creation_task": creation,
+            }
+            actor = ActorRecord(aid, spec)
+            self.actors[aid] = actor
+            name = spec.get("name")
+            if name:
+                # The live instance wins over the snapshot's replay: drop
+                # the deferred re-creation and any tombstone for the name.
+                self.named_actors[name] = aid
+                self._restore_named_pending.pop(name, None)
+                self.named_tombstones.pop(name, None)
+                self._mark_dirty()
+            # A later worker death restarts the adopted actor through the
+            # normal path: the shipped creation spec is complete (func_key,
+            # args), so _handle_worker_death can resubmit it.
+            self._event("actor_adopted", actor=aid.hex(),
+                        worker=w.worker_id.hex())
+        actor.state = "ALIVE"
+        actor.worker_id = w.worker_id
+        actor.node_id = w.node_id
+        w.state = ACTOR
+        w.actor_id = aid
+        await self._publish(f"actor:{aid.hex()}", {"state": "ALIVE"})
+        # Refresh client route caches with the (unchanged) peer address —
+        # clients that dropped the route during the outage re-learn it
+        # without a resolve round trip.
+        await self._publish_actor_event(actor, "ALIVE")
+        # Submissions that raced ahead of this adoption were parked: they
+        # re-enter now, in arrival order, ahead of anything newer.
+        await self._drain_parked_unknown_actor_tasks()
+        if actor.pending_tasks:
+            await self._drain_actor_queue(actor)
 
     async def _on_disconnect(self, conn: Connection):
         # Non-detached placement groups die with their creator's connection
@@ -1284,6 +1478,11 @@ class Head:
             actor = self.actors.get(aid)
             if actor is not None and actor.state != "DEAD":
                 named[name] = actor.spec
+        # Restored-but-not-yet-replayed named actors (resync grace window
+        # still open) must survive a crash-during-restore: carry them
+        # through verbatim.
+        for name, spec in self._restore_named_pending.items():
+            named.setdefault(name, spec)
         # Durable tables: KV, named/detached actor specs, and every live
         # placement group's creation body (reserved or still pending) —
         # the reference persists these in Redis-backed GCS tables
@@ -1306,13 +1505,29 @@ class Head:
                     "tombstones": dict(self.named_tombstones)}
 
         def dump():
-            import cloudpickle
+            # The dirty bit was cleared BEFORE this off-loop write and the
+            # executor future is never awaited — so a failed write (disk
+            # full, ENOSPC, permissions) must re-arm it itself, or the
+            # snapshot stays silently stale forever while the head keeps
+            # reporting itself durable.
+            try:
+                import cloudpickle
 
-            blob = cloudpickle.dumps(snapshot)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, path)
+                blob = cloudpickle.dumps(snapshot)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)
+            except BaseException:
+                import traceback
+
+                self._state_dirty = True  # retry on the next periodic tick
+                print(
+                    "ray_tpu head: persist_state write to "
+                    f"{path!r} FAILED — on-disk snapshot is stale and will "
+                    "be retried:\n" + traceback.format_exc(),
+                    file=sys.stderr, flush=True,
+                )
 
         try:
             asyncio.get_running_loop().run_in_executor(None, dump)
@@ -1323,6 +1538,17 @@ class Head:
         """Load a snapshot: KV merges in; named actors are re-created by
         resubmitting their creation specs (args that lived in the old shm
         session are gone — only inline-args actors restore)."""
+        # Open the resync grace window unconditionally at boot: a head
+        # restarted WITHOUT a snapshot (crash before the first persist, or
+        # no state path configured) still receives field reports and
+        # reconnected-driver replays in arbitrary order — unknown-actor
+        # submissions and orphan completions must park/seal during the
+        # window regardless of snapshot presence.  Harmless on a genuinely
+        # fresh cluster: legitimate submissions always follow their
+        # create_actor on the same connection.
+        self._resync_grace_until = (
+            time.monotonic() + self.config.head_resync_grace_s
+        )
         path = self.config.head_state_path
         if not path or not os.path.exists(path):
             return
@@ -1341,6 +1567,7 @@ class Head:
         for ev in state.get("task_events", []):
             self.task_events.append(ev)
         self._event("head_restarted")
+        self.builtin_metrics.head_restarts.inc()
         self.named_tombstones.update(state.get("tombstones", {}))
         # PGs first: restored actors may target them.  Replaying the
         # creation body re-reserves bundles on the current node set; with
@@ -1352,29 +1579,64 @@ class Head:
                 continue
             try:
                 await self.h_create_placement_group(None, body)
-            except Exception:
-                pass
+            except Exception as e:
+                # A skipped PG must be VISIBLE: post-mortems need to know
+                # what did not come back, not infer it from a hang.
+                self._event("head_restore_skipped", entity="placement_group",
+                            id=pg_id.hex(), reason=repr(e))
+                print(
+                    "ray_tpu head: restore skipped placement group "
+                    f"{pg_id.hex()}: {e!r}",
+                    file=sys.stderr, flush=True,
+                )
+        # Named actors do NOT replay immediately: the field may still hold
+        # the live instances (workers survive a head restart in headless
+        # mode and re-register carrying their actors).  Stage the specs and
+        # let the periodic loop replay whatever the resync grace window
+        # leaves unclaimed — adoption of a live actor always beats
+        # re-creating it fresh.
+        staged = 0
         for name, spec in state.get("named_actors", {}).items():
             if name in self.named_actors:
                 continue
-            ct = spec.get("creation_task", {})
-            if ct.get("arg_ids") or ct.get("args_ref"):
-                # Constructor args lived in the old session's shm — a
-                # resubmit would dep-block forever and wedge the name.
-                # Tombstone it so get_actor(name) explains the loss
-                # instead of a bare "no actor with name".
-                self.named_tombstones[name] = (
-                    "lost in head restart: the actor's constructor "
-                    "arguments lived in the previous session's object "
-                    "store and are not durable; re-create it with "
-                    "inline-serializable arguments to survive restarts"
-                )
-                self._mark_dirty()
-                continue
-            try:
-                await self.h_create_actor(None, spec)
-            except Exception:
-                pass
+            self._restore_named_pending[name] = spec
+            staged += 1
+        if staged:
+            self._restore_named_deadline = (
+                time.monotonic() + self.config.head_resync_grace_s
+            )
+
+    async def _replay_named_actor(self, name: str, spec: dict):
+        """Re-create one snapshot-restored named actor that no field report
+        claimed within the resync grace window."""
+        if name in self.named_actors:
+            return  # adopted (or re-created by a client) meanwhile
+        ct = spec.get("creation_task", {})
+        if ct.get("arg_ids") or ct.get("args_ref"):
+            # Constructor args lived in the old session's shm — a
+            # resubmit would dep-block forever and wedge the name.
+            # Tombstone it so get_actor(name) explains the loss
+            # instead of a bare "no actor with name".
+            self.named_tombstones[name] = (
+                "lost in head restart: the actor's constructor "
+                "arguments lived in the previous session's object "
+                "store and are not durable; re-create it with "
+                "inline-serializable arguments to survive restarts"
+            )
+            self._event("head_restore_skipped", entity="named_actor",
+                        id=name, reason="constructor args not durable")
+            self._mark_dirty()
+            return
+        try:
+            await self.h_create_actor(None, spec)
+        except Exception as e:
+            self._event("head_restore_skipped", entity="named_actor",
+                        id=name, reason=repr(e))
+            print(
+                f"ray_tpu head: restore skipped named actor {name!r}: "
+                f"{e!r}",
+                file=sys.stderr, flush=True,
+            )
 
     async def h_batch(self, conn, body):
         """Mixed fire-and-forget batch: one RPC carries many submissions /
@@ -1533,7 +1795,11 @@ class Head:
                 rec.size = entry["size"]
                 node_id = NodeID(entry["node_id"])
                 rec.locations.add(node_id)
-                self._adopt_local(oid, node_id)
+                if not (entry.get("resync") and node_id != self.local_node_id):
+                    # Resync manifests come FROM the owning node's daemon —
+                    # it already accounts these segments; pushing adopt
+                    # back at it for a whole manifest is pure noise.
+                    self._adopt_local(oid, node_id)
             rec.sealed = True
             rec.ref_count = max(rec.ref_count, 1)
             self._notify_object_ready(oid)
@@ -2313,6 +2579,16 @@ class Head:
         worker_id = self.conn_to_worker.get(conn.conn_id)
         worker = self.workers.get(worker_id) if worker_id else None
         if task is None:
+            # Unknown task: either a stale duplicate, or a completion that
+            # outlived a HEAD RESTART (the worker kept executing headless
+            # and replayed the report after resync — the task record died
+            # with the old head).  Only the restart case may seal: the
+            # resync grace window is the discriminator.  A same-head blip
+            # replay (task already requeued, run elsewhere, maybe freed)
+            # must be DROPPED — sealing would resurrect freed records with
+            # a ref nothing owns.
+            if time.monotonic() < self._resync_grace_until:
+                self._seal_orphan_returns(body, worker)
             return {}
         failed = body.get("error") is not None
         actor_creation = task.spec.get("is_actor_creation", False)
@@ -2430,6 +2706,44 @@ class Head:
         self._kick()
         return {}
 
+    def _seal_orphan_returns(self, body, worker: Optional[WorkerState]):
+        """Seal return objects of a task this head has no record of (a
+        completion replayed across a head restart).  Only objects someone
+        can still reach matter, but the creator's ref is alive by
+        construction (the submitting driver survived the head, or the
+        report wouldn't have been replayed) — so register unconditionally;
+        the creator's eventual free reclaims the record."""
+        returns = body.get("returns") or []
+        if not returns:
+            return
+        failed = body.get("error") is not None
+        sealed = 0
+        for ret in returns:
+            oid = ObjectID(ret["object_id"])
+            rec = self._obj(oid)
+            if failed:
+                if rec.sealed and (rec.inline is not None or rec.locations):
+                    continue  # never clobber live data with a late failure
+                rec.error = body["error"]
+            elif ret.get("inline") is not None:
+                rec.error = None
+                rec.inline = ret["inline"]
+                rec.size = len(rec.inline)
+            elif ret.get("size") is not None:
+                rec.error = None
+                rec.size = ret["size"]
+                loc = worker.node_id if worker else self.local_node_id
+                rec.locations.add(loc)
+                self._adopt_local(oid, loc)
+            else:
+                continue
+            rec.sealed = True
+            sealed += 1
+            self._notify_object_ready(oid)
+        if sealed:
+            self._event("task_done", task=TaskID(body["task_id"]).hex(),
+                        failed=failed, orphan=True)
+
     def _retire_worker(self, worker: WorkerState):
         """Tell a chip-granted pooled worker to exit: its process keeps the
         TPU devices mapped, so the chip IDs only become reusable at process
@@ -2540,8 +2854,12 @@ class Head:
             "load1": body.get("load1"),
             "mem_used_frac": body.get("mem_used_frac"),
             "num_worker_procs": body.get("num_worker_procs"),
+            "headless_s": body.get("headless_s"),
             "time": time.time(),
         }
+        if body.get("headless_s") is not None:
+            self.builtin_metrics.headless_seconds.set(
+                float(body["headless_s"]), tags={"node": node_id.hex()})
         return {}
 
     async def h_node_health_ack(self, conn, body):
@@ -2670,13 +2988,53 @@ class Head:
             # A fresh creation supersedes any restart-loss tombstone.
             self.named_tombstones.pop(actor.name, None)
             self._mark_dirty()
+        # Stamp the actor-level metadata into the creation task the worker
+        # will receive and RETAIN: it is the worker's field-state report
+        # after a head restart, and the restarted head rebuilds this exact
+        # ActorRecord from it (see _resync_worker_adopt).
+        body["creation_task"]["actor_meta"] = {
+            k: body.get(k)
+            for k in ("class_name", "name", "namespace", "max_restarts",
+                      "max_task_retries", "method_names", "method_defaults",
+                      "lifetime")
+        }
         self.actors[actor_id] = actor
         await self.h_submit_task(conn, body["creation_task"])
         return {}
 
+    async def _drain_parked_unknown_actor_tasks(self, force: bool = False):
+        """Re-run parked unknown-actor submissions whose actor is now
+        known (adoption or snapshot replay landed).  With ``force`` (grace
+        window closed) everything re-runs — still-unknown actors then take
+        the normal typed ActorDiedError path."""
+        if not self._parked_unknown_actor_tasks:
+            return
+        parked, self._parked_unknown_actor_tasks = \
+            self._parked_unknown_actor_tasks, []
+        keep: List[dict] = []
+        for body in parked:
+            if force or ActorID(body["actor_id"]) in self.actors:
+                try:
+                    await self.h_submit_actor_task(None, body)
+                except Exception:
+                    pass
+            else:
+                keep.append(body)
+        # Preserve arrival order for specs still waiting on their adoption
+        # (anything parked by the re-runs above lands after them, which
+        # matches submission order per actor).
+        self._parked_unknown_actor_tasks[:0] = keep
+
     async def h_submit_actor_task(self, conn, body):
         actor_id = ActorID(body["actor_id"])
         actor = self.actors.get(actor_id)
+        if actor is None and time.monotonic() < self._resync_grace_until:
+            # Head-restart resync race: a reconnected driver's buffered
+            # submissions can replay BEFORE the hosting worker's field
+            # report adopts the actor.  Park the spec for the grace window;
+            # adoption (or named replay) drains it, expiry fails it typed.
+            self._parked_unknown_actor_tasks.append(body)
+            return {}
         if actor is None or actor.state == "DEAD":
             err = serialization.pack(
                 ActorDiedError(actor_id.hex(), actor.death_cause if actor else "unknown actor")
@@ -3106,6 +3464,9 @@ class Head:
         if worker is None:
             return
         worker.state = DEAD
+        self._event("worker_died", worker=worker_id.hex(),
+                    actor=worker.actor_id.hex() if worker.actor_id else None,
+                    inflight=len(worker.inflight))
         # A leased slot dies with its worker: release the resources now and
         # tell the owner so it drops the slot (its in-flight specs fail on
         # the peer connection and fall back to the head path).

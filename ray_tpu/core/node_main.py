@@ -261,12 +261,22 @@ class NodeDaemon:
         "worker_pids": "_workers_lock",
         "worker_procs": "_workers_lock",
         "zygote": "_zygote_lock",
+        "_reconnecting": "_reconnect_guard",
+        "_headless_since": "_reconnect_guard",
+        "headless_total_s": "_reconnect_guard",
     }
     _RT_UNGUARDED = {
         "head": "write-once in start() before any push handler is "
-                "registered on it; handlers only run after registration",
+                "registered on it; afterwards only the single reconnect "
+                "thread rebinds it (a racing reader uses the dying client "
+                "once more and its call fails like the connection loss it "
+                "is recovering from)",
         "node_id": "write-once after register(); the health-check lambda "
                    "guards the pre-registration None window",
+        "_server_port": "write-once in start() before the head connection "
+                        "exists; the reconnect thread (which re-reads it "
+                        "for the re-register body) can only run after a "
+                        "connection loss, which needs that connection",
     }
 
     def __init__(self):
@@ -322,24 +332,31 @@ class NodeDaemon:
         self._drain_requested = False
         self._drain_deadline: Optional[float] = None
         self._drain_min_wait = 1.0
+        # Headless degraded mode: when the head connection drops, ONE
+        # reconnect thread redials with backoff (workers keep executing,
+        # the store keeps serving pulls) until re-registered or the suicide
+        # deadline passes.  headless_total_s is cumulative across outages
+        # (reported in node_stats and the resync register).
+        self._reconnect_guard = make_lock("node.reconnect_guard")
+        self._reconnecting = False
+        self._headless_since: Optional[float] = None
+        self.headless_total_s = 0.0
+        self._server_port = 0
 
-    def start(self):
-        port = self.server_thread.start()
-        self.head = RpcClient(
-            *self._split(self.head_addr), name="node-daemon-rpc"
-        )
-        self.head.on_push("spawn_worker", self._on_spawn_worker)
-        self.head.on_push("kill_worker", self._on_kill_worker)
-        self.head.on_push("free_objects", self._on_free_objects)
-        self.head.on_push("adopt_object", self._on_adopt_object)
-        self.head.on_push("shutdown", lambda b: self._shutdown.set())
-        self.head.on_push(
+    def _install_push_handlers(self, client: RpcClient):
+        client.on_push("spawn_worker", self._on_spawn_worker)
+        client.on_push("kill_worker", self._on_kill_worker)
+        client.on_push("free_objects", self._on_free_objects)
+        client.on_push("adopt_object", self._on_adopt_object)
+        client.on_push("shutdown", lambda b: self._shutdown.set())
+        client.on_push(
             "health_check",
             lambda b: self.head.call_async(
                 "node_health_ack", {"node_id": self.node_id.binary()}
             ) if self.node_id else None,
         )
-        self.head.on_connection_lost = lambda: os._exit(0)
+
+    def _register_body(self) -> dict:
         from . import schema as wire_schema
 
         body = {
@@ -349,14 +366,25 @@ class NodeDaemon:
             "labels": self.labels,
             "num_workers": self.num_workers,
             "store_session": self.session,
-            "object_addr": f"{self.host}:{port}",
+            "object_addr": f"{self.host}:{self._server_port}",
             "bulk_addr": f"{self.host}:{self.bulk_server.port}",
             "pid": os.getpid(),
             "log_path": own_log_path(),
         }
-        if os.environ.get("RT_NODE_ID"):  # pre-assigned (cluster_utils)
+        if self.node_id is not None:
+            body["node_id"] = self.node_id.binary()
+        elif os.environ.get("RT_NODE_ID"):  # pre-assigned (cluster_utils)
             body["node_id"] = bytes.fromhex(os.environ["RT_NODE_ID"])
-        reply = self.head.call("register", body)
+        return body
+
+    def start(self):
+        self._server_port = self.server_thread.start()
+        self.head = RpcClient(
+            *self._split(self.head_addr), name="node-daemon-rpc"
+        )
+        self._install_push_handlers(self.head)
+        self.head.on_connection_lost = self._on_head_lost
+        reply = self.head.call("register", self._register_body())
         self.node_id = NodeID(reply["node_id"])
         # Boot the zygote eagerly so the first spawn request doesn't pay
         # the forkserver's one-time import cost.  Under the lock: a
@@ -458,6 +486,113 @@ class NodeDaemon:
         except (FileNotFoundError, MemoryError):
             pass
 
+    # ------------------------------------------- headless mode / head restart
+
+    def _on_head_lost(self):
+        """Lost head connection (runs on the dying rpc loop thread): enter
+        headless degraded mode instead of dying.  While headless, running
+        workers keep executing (their own reconnect loops handle the head),
+        the object store keeps serving pulls, and granted leases keep
+        draining — only head-mediated ops (spawns, frees, stats) pause."""
+        if self._shutdown.is_set() or self._drain_requested \
+                or self._drain_deadline is not None:
+            return  # already exiting: the run loop owns teardown
+        with self._reconnect_guard:
+            if self._reconnecting:
+                return
+            self._reconnecting = True
+            self._headless_since = time.monotonic()
+        threading.Thread(target=self._reconnect_loop, daemon=True,
+                         name="head-reconnect").start()
+
+    def _reconnect_loop(self):
+        import random
+
+        deadline = get_config().head_reconnect_deadline_s
+        start = time.monotonic()
+        backoff = 0.1
+        while not self._shutdown.is_set():
+            if time.monotonic() - start > deadline:
+                print(
+                    f"ray_tpu node daemon (session {self.session}): head "
+                    f"did not return within {deadline:.0f}s "
+                    "(head_reconnect_deadline_s); shutting the node down",
+                    file=sys.stderr, flush=True,
+                )
+                # The run loop's teardown SIGTERMs workers, closes the
+                # zygote, and shuts the store — no orphaned processes.
+                self._shutdown.set()
+                return
+            try:
+                self._reconnect_once()
+                with self._reconnect_guard:
+                    self._reconnecting = False
+                    if self._headless_since is not None:
+                        self.headless_total_s += (
+                            time.monotonic() - self._headless_since
+                        )
+                    self._headless_since = None
+                return
+            except Exception:
+                pass
+            time.sleep(backoff * (0.5 + random.random()))
+            backoff = min(backoff * 2, 2.0)
+
+    def _reconnect_once(self):
+        """One redial + re-register carrying this node's field state; on
+        success, swap the client and replay the store manifest so the
+        restarted head rebuilds its object directory (rides the existing
+        segment-adoption path in put_object_batch)."""
+        client = RpcClient(
+            *self._split(self.head_addr), name="node-daemon-rpc"
+        )
+        manifest = self.store.manifest()
+        try:
+            self._install_push_handlers(client)
+            body = self._register_body()
+            body["reconnect"] = True
+            self._prune_worker_pids()
+            with self._workers_lock:
+                pids = list(self.worker_pids) + [
+                    p.pid for p in self.worker_procs if p.poll() is None
+                ]
+            with self._reconnect_guard:
+                headless_s = self.headless_total_s + (
+                    (time.monotonic() - self._headless_since)
+                    if self._headless_since is not None else 0.0
+                )
+            body["resync"] = {
+                "worker_pids": pids,
+                "headless_s": headless_s,
+                "num_objects": len(manifest),
+            }
+            reply = client.call("register", body)
+            self.node_id = NodeID(reply["node_id"])
+            client.on_connection_lost = self._on_head_lost
+        except BaseException:
+            try:
+                client.close()
+            except Exception:
+                pass
+            raise
+        old, self.head = self.head, client
+        try:
+            old.on_connection_lost = None
+            old.close()
+        except Exception:
+            pass
+        # Field-state resync, object half: every object this store can
+        # still serve re-enters the head's directory (adopt path tolerates
+        # already-known ids, so a plain blip just re-asserts records).
+        node_raw = self.node_id.binary()
+        for i in range(0, len(manifest), 2000):
+            entries = [
+                {"object_id": oid.binary(), "size": size,
+                 "node_id": node_raw, "resync": True}
+                for oid, size in manifest[i:i + 2000]
+            ]
+            client.call("put_object_batch", {"objects": entries})
+
     # ------------------------------------------------------------- draining
 
     def request_drain(self):
@@ -518,6 +653,11 @@ class NodeDaemon:
             load1 = 0.0
         from .config import host_memory_used_frac
 
+        with self._reconnect_guard:
+            headless_s = self.headless_total_s + (
+                (time.monotonic() - self._headless_since)
+                if self._headless_since is not None else 0.0
+            )
         stats = {
             "node_id": self.node_id.binary(),
             "store": self.store.stats(),
@@ -526,6 +666,10 @@ class NodeDaemon:
             "num_worker_procs": (
                 len(self.worker_pids) + len(self.worker_procs)  # rt-unguarded: len() snapshot for best-effort stats
             ),
+            # Cumulative seconds this daemon has spent without a head
+            # connection (surfaced as the per-node
+            # ray_tpu_headless_seconds gauge head-side).
+            "headless_s": headless_s,
         }
         try:
             self.head.call_async("node_stats", stats)

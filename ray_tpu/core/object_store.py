@@ -222,6 +222,26 @@ class ObjectStore:
                 return self._restore(object_id)
             return None
 
+    def manifest(self) -> list:
+        """(object_id, size) of every object this store can still serve —
+        sealed shm segments plus spilled entries (restorable on access).
+        The field-state report a node carries when it re-registers with a
+        restarted head: the head rebuilds its volatile object directory
+        from these (reference: GCS FT — raylets replay their object
+        tables to a restarted GCS)."""
+        out = []
+        with self._lock:
+            for oid, seg in self._objects.items():
+                out.append((oid, seg.size))
+            for oid, path in self._spilled.items():
+                if oid in self._objects:
+                    continue
+                try:
+                    out.append((oid, os.path.getsize(path)))
+                except OSError:
+                    pass  # spill file gone: nothing to report
+        return out
+
     def count_transferred(self, nbytes: int) -> None:
         """Account bytes served to a cross-node pull (called by the pull
         handlers in node_main)."""

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import sys
 import threading
 import traceback
 from typing import Any, Awaitable, Callable, Dict, Optional
@@ -315,8 +316,16 @@ class RpcClient:
                             fn(body)
                         except Exception:
                             traceback.print_exc()
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
+                asyncio.CancelledError):
+            pass  # CancelledError: voluntary close() tearing the task down
+        except BaseException as e:  # noqa: BLE001 — diagnose, treat as loss
+            # An unexpected reader death (decode error, oversized frame) is
+            # indistinguishable from connection loss to callers — but it is
+            # a bug worth seeing: reconnect loops would redial forever.
+            print(f"rpc {self.host}:{self.port} read loop died: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+            traceback.print_exc()
         finally:
             self.closed = True
             self._fail_outbox()

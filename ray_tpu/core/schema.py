@@ -29,6 +29,14 @@ _NUM = (int, float)
 
 #: method -> ((field, allowed types | None for any), ...)
 REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
+    # `register` doubles as the field-state RESYNC message (head restart
+    # survival): with ``reconnect: true`` the body carries the process's
+    # existing identity (worker_id/node_id/peer_addr) plus a ``resync``
+    # map — workers: {actor_id, creation_spec (with actor_meta), running
+    # _tasks}; nodes: {worker_pids, headless_s}.  The head adopts the
+    # reported state or answers {"refused": reason}; object manifests
+    # replay separately through put_object_batch entries (optionally
+    # flagged ``resync: true`` to skip the adopt push-back).
     "register": (("kind", str),),
     "submit_task": (
         ("task_id", _BYTES),

@@ -147,11 +147,27 @@ class HeadMetrics:
             "Task-lease revocations (TTL expiry, node drain, worker death, "
             "or scheduler preemption of idle-held slots)",
             tag_keys=("reason",), register=False)
+        # -- head fault tolerance (headless mode + field-state resync) --------
+        self.head_restarts = Counter(
+            "ray_tpu_head_restarts_total",
+            "Head restarts observed (durable snapshot restored at boot)",
+            register=False)
+        self.headless_seconds = Gauge(
+            "ray_tpu_headless_seconds",
+            "Cumulative seconds each node daemon has run without a head "
+            "connection (reconnect loop active, field ops degraded)",
+            tag_keys=("node",), register=False)
+        self.resync_reports = Counter(
+            "ray_tpu_resync_reports_total",
+            "Field-state resync reports adopted at re-register (nodes "
+            "replaying store manifests, workers re-binding live actors)",
+            tag_keys=("kind",), register=False)
         self._all = [
             self.submit_to_start, self.queue_depth, self.tasks_dispatched,
             self.task_duration, self.store_used, self.store_capacity,
             self.store_stored, self.store_transferred, self.store_hit_rate,
             self.lease_revocations,
+            self.head_restarts, self.headless_seconds, self.resync_reports,
         ]
 
     def sample_store(self, stats: dict) -> None:

@@ -166,6 +166,7 @@ class Worker:
         "direct_streams": "_streams_lock",
         "_direct_replies": "_direct_replies_lock",
         "_direct_replies_scheduled": "_direct_replies_lock",
+        "_reconnecting": "_reconnect_guard",
     }
     # Intentional cross-thread handoffs, vetted per CONTRIBUTING's
     # thread-role model: each is either ordered by the task queue (the
@@ -174,6 +175,9 @@ class Worker:
     _RT_UNGUARDED = {
         "fn_cache": "content-addressed idempotent cache: a racing double "
                     "load stores the same value twice",
+        "actor_creation_spec": "written by the actor-creation task (task "
+                               "queue orders it before method dispatch); "
+                               "the reconnect thread only reads it",
         "running_threads": "GIL-atomic dict set/pop keyed by task_id; "
                            "readers (cancel, stack dump) are best-effort",
         "cancelled": "GIL-atomic monotonic set.add; a cancel losing the "
@@ -249,6 +253,11 @@ class Worker:
         self.task_queue: "queue.Queue" = queue.Queue()
         self.fn_cache: Dict[str, Any] = {}
         self.actor_instance = None
+        # Retained actor-creation spec: the field-state report this worker
+        # carries when it re-registers with a restarted head — enough for
+        # the head to rebuild a full-fidelity ActorRecord (adoption) for
+        # the live actor instead of recreating it fresh.
+        self.actor_creation_spec: Optional[dict] = None
         self.actor_id: Optional[bytes] = None
         self.max_concurrency = 1
         self.pool: Optional[ThreadPoolExecutor] = None
@@ -272,7 +281,17 @@ class Worker:
         self.client.rpc.on_push("execute_task", _on_exec)
         self.client.rpc.on_push("cancel", self._on_cancel)
         self.client.rpc.on_push("shutdown", lambda b: self._shutdown.set())
-        self.client.rpc.on_push("exit", lambda b: os._exit(1))
+        # Head-initiated kill: exit through the clean-shutdown drain (log
+        # tees' trailing partial line + final metrics window) instead of a
+        # bare os._exit that drops them.  On a fresh thread — the drain
+        # fires RPCs and must not run on (and block) the rpc loop itself.
+        self.client.rpc.on_push(
+            "exit",
+            lambda b: threading.Thread(
+                target=self._exit_with_drain, args=(1,), daemon=True,
+                name="exit-drain",
+            ).start(),
+        )
         # Liveness probe: ack from the rpc loop thread (call_async is safe
         # there; a blocking call would deadlock the loop).  A wedged
         # interpreter stops acking and the head reaps us.
@@ -286,7 +305,15 @@ class Worker:
         # collective; reference: `ray stack` attaches py-spy, here the
         # worker cooperates via sys._current_frames).
         self.client.rpc.on_push("stack_dump", self._on_stack_dump)
-        self.client.rpc.on_connection_lost = lambda: os._exit(0)
+        # Headless degraded mode: a lost head connection starts a reconnect
+        # loop instead of killing the process — in-flight tasks, direct
+        # peer calls, and peer streaming keep executing; completion reports
+        # buffer in the client and replay at re-register.  The deadline
+        # guarantees an orphaned worker (head never restarted) still dies.
+        self._reconnect_guard = make_lock("worker.reconnect_guard")
+        self._reconnecting = False
+        self.client.resync_payload = self._resync_payload
+        self.client.rpc.on_connection_lost = self._on_head_lost
         # Stream this worker's stdout/stderr to the driver (log files keep
         # the full copy); RT_LOG_TO_DRIVER=0 disables.
         if os.environ.get("RT_LOG_TO_DRIVER", "1") != "0":
@@ -843,6 +870,12 @@ class Worker:
                 cls = self._load(spec["func_key"])
                 args, kwargs = self._resolve_args(spec)
                 self.actor_instance = cls(*args, **kwargs)
+                # Retained for head-restart resync: the re-register report
+                # ships this spec so a restarted head can adopt the live
+                # actor (wire-clean copy: internal "_" keys stripped).
+                self.actor_creation_spec = {
+                    k: v for k, v in spec.items() if not k.startswith("_")
+                }
                 self.actor_id = spec["actor_id"]
                 ctx.current_actor_id = ActorID(self.actor_id)
                 self.max_concurrency = spec.get("max_concurrency", 1)
@@ -1096,6 +1129,97 @@ class Worker:
 
         asyncio.run_coroutine_threadsafe(run(), self.async_loop)
 
+    # ------------------------------------------- headless mode / head restart
+
+    def _resync_payload(self) -> dict:
+        """Field-state report carried on a reconnect register: the hosted
+        actor (with its full creation spec, so a restarted head can rebuild
+        a full-fidelity record and adopt the LIVE instance) plus the tasks
+        still executing here (for observability)."""
+        out: Dict[str, Any] = {
+            "running_tasks": list(self.running_threads.keys()),
+        }
+        if self.actor_id is not None:
+            out["actor_id"] = self.actor_id
+            spec = self.actor_creation_spec
+            if spec is not None:
+                out["creation_spec"] = spec
+                meta = spec.get("actor_meta") or {}
+                if meta.get("name"):
+                    out["actor_name"] = meta["name"]
+        return out
+
+    def _on_head_lost(self):
+        """Lost head connection (runs on the dying rpc loop thread): enter
+        headless degraded mode.  One reconnect thread, claim-then-act."""
+        if self._shutdown.is_set():
+            # Already shutting down: exit now, but through the same drain
+            # (trailing log line + final metrics) every other exit takes.
+            self._exit_with_drain(0)
+        with self._reconnect_guard:
+            if self._reconnecting:
+                return
+            self._reconnecting = True
+        threading.Thread(target=self._reconnect_loop, daemon=True,
+                         name="head-reconnect").start()
+
+    def _reconnect_loop(self):
+        """Redial the head with jittered backoff until re-registered or the
+        suicide deadline passes.  While this runs, the execution side keeps
+        working: task threads run, peer_submit keeps accepting direct
+        calls, and completed head-routed reports buffer in the client for
+        replay at re-register."""
+        import random
+
+        deadline = get_config().head_reconnect_deadline_s
+        start = time.monotonic()
+        backoff = 0.1
+        while not self._shutdown.is_set():
+            if time.monotonic() - start > deadline:
+                print(
+                    f"ray_tpu worker {self.worker_id.hex()[:8]}: head did "
+                    f"not return within {deadline:.0f}s "
+                    "(head_reconnect_deadline_s); exiting",
+                    file=sys.stderr, flush=True,
+                )
+                self._exit_with_drain(0)
+            try:
+                if self.client._try_reconnect():
+                    with self._reconnect_guard:
+                        self._reconnecting = False
+                    return
+            except Exception:
+                pass
+            if self.client.reconnect_refused is not None:
+                # The head refused to adopt this identity (stale actor
+                # incarnation, dead actor): this process's state is
+                # unwanted — exit now, cleanly.
+                print(
+                    f"ray_tpu worker {self.worker_id.hex()[:8]}: head "
+                    f"refused re-register "
+                    f"({self.client.reconnect_refused}); exiting",
+                    file=sys.stderr, flush=True,
+                )
+                self._exit_with_drain(0)
+            time.sleep(backoff * (0.5 + random.random()))
+            backoff = min(backoff * 2, 2.0)
+        # Shutdown won the race: the run loop owns the exit path.
+
+    def _exit_with_drain(self, code: int):
+        """Terminal exit through the clean-shutdown drain: ship the log
+        tees' trailing partial lines and the final metrics window, then
+        _exit.  Never raises; never returns."""
+        try:
+            for stream in (sys.stdout, sys.stderr):
+                if isinstance(stream, _LogTee):
+                    stream.flush_residual()
+            from ray_tpu.util.metrics import _final_flush
+
+            _final_flush()
+        except BaseException:  # noqa: BLE001 — exiting regardless
+            pass
+        os._exit(code)
+
     # ---------------------------------------------------------- introspection
 
     def _on_stack_dump(self, body):
@@ -1184,16 +1308,7 @@ class Worker:
         # Clean shutdown: os._exit skips atexit, so drain the log tees'
         # trailing partial lines and ship the final metrics window (incl.
         # the logs-dropped counter) explicitly.
-        try:
-            for stream in (sys.stdout, sys.stderr):
-                if isinstance(stream, _LogTee):
-                    stream.flush_residual()
-            from ray_tpu.util.metrics import _final_flush
-
-            _final_flush()
-        except Exception:
-            pass
-        os._exit(0)
+        self._exit_with_drain(0)
 
 
 def main():

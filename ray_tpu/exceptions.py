@@ -60,5 +60,38 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+from .core.rpc import ConnectionLost as _ConnectionLost
+
+
+class HeadRestartedError(RayTpuError, _ConnectionLost):
+    """A non-idempotent control-plane call was interrupted by a lost head
+    connection — the head may have crashed/restarted mid-call, so the
+    framework cannot know whether the operation landed.  Carries the method
+    (and an optional detail) so the caller can decide to resubmit
+    (reference: GCS FT — non-retryable RPCs surface to the caller on a GCS
+    failover instead of being silently replayed).  Subclasses
+    ``core.rpc.ConnectionLost`` so existing connection-error handling keeps
+    working; catch this type specifically to implement resubmission.
+    """
+
+    def __init__(self, method: str, detail: str = ""):
+        msg = (
+            f"head connection lost during non-idempotent call {method!r}; "
+            "the head may have restarted — idempotent state was preserved "
+            "by the durable snapshot, but this operation must be "
+            "resubmitted by the caller"
+        )
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.method = method
+        self.detail = detail
+
+    def __reduce__(self):
+        # Default Exception reduce would re-init with the formatted message
+        # as `method`, garbling both attributes after crossing the wire.
+        return (type(self), (self.method, self.detail))
+
+
 class RuntimeEnvSetupError(RayTpuError):
     pass

@@ -112,6 +112,24 @@ def cmd_status(args) -> int:
         cap_b = stats.get("capacity_bytes", 0)
         print(f"object store: {used_b / 2**20:.1f}/{cap_b / 2**20:.1f} "
               "MiB used (head node)")
+        # Head fault-tolerance posture: restarts survived, field resyncs
+        # adopted, and per-node headless time (ray_tpu_headless_seconds).
+        try:
+            rows = cl.call("list_state", {"kind": "metrics"})["items"]
+            restarts = sum(r.get("value", 0) for r in rows
+                           if r["name"] == "ray_tpu_head_restarts_total")
+            resyncs = sum(r.get("value", 0) for r in rows
+                          if r["name"] == "ray_tpu_resync_reports_total")
+            headless = [(r.get("tags", {}).get("node", "?")[:8],
+                         r.get("value", 0.0)) for r in rows
+                        if r["name"] == "ray_tpu_headless_seconds"]
+            if restarts or resyncs or headless:
+                print(f"head restarts: {restarts:g}  "
+                      f"resync reports: {resyncs:g}")
+                for node, secs in sorted(headless):
+                    print(f"  node {node}: {secs:.1f}s headless")
+        except Exception:
+            pass  # older head without the FT metrics: stay quiet
     finally:
         cl.close()
     return 0

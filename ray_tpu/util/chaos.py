@@ -208,6 +208,75 @@ class PreemptionInjector:
         self.stop()
 
 
+class HeadKillInjector:
+    """SIGKILLs an :class:`~ray_tpu.cluster_utils.ExternalHead` on a cadence
+    and restarts it after a configurable outage window — the control-plane
+    crash drill (reference: the GCS FT release tests kill the GCS process
+    under load and assert raylets/workers resync).  Each cycle is
+    kill → outage_s of headless cluster → restart-with-same-identity;
+    nodes/workers ride their reconnect loops, drivers re-register, and the
+    assertion hook is ``kills`` plus whatever invariants the workload
+    checks (e.g. zero failed direct calls).
+
+    ``delay_s`` postpones the first kill (let the workload reach steady
+    state); ``max_kills`` bounds the blast radius so a soak asserts
+    recovery rather than an endless outage.
+    """
+
+    def __init__(self, head, interval_s: float = 5.0,
+                 outage_s: float = 1.0, max_kills: Optional[int] = 1,
+                 delay_s: float = 0.0):
+        self.head = head
+        self.interval_s = interval_s
+        self.outage_s = outage_s
+        self.max_kills = max_kills
+        self.delay_s = delay_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    def kill_once(self) -> bool:
+        """One full kill→outage→restart cycle, synchronously."""
+        try:
+            self.head.kill()
+        except Exception:
+            return False
+        self.kills += 1
+        self._stop.wait(self.outage_s)
+        self.head.restart()
+        return True
+
+    def _loop(self):
+        if self.delay_s and self._stop.wait(self.delay_s):
+            return
+        while True:
+            if (self.max_kills is not None
+                    and self.kills >= self.max_kills):
+                return
+            self.kill_once()
+            if self._stop.wait(self.interval_s):
+                return
+
+    def start(self) -> "HeadKillInjector":
+        self._thread = threading.Thread(
+            target=self._loop, name="head-kill-injector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+        return self.kills
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
 def run_under_chaos(fn, *, interval_s: float = 0.5, timeout_s: float = 60.0,
                     seed: int = 0):
     """Run ``fn()`` while a WorkerKiller fires; returns (result, kills).

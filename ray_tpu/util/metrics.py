@@ -70,6 +70,10 @@ BUILTIN_METRICS: Dict[str, str] = {
     "ray_tpu_direct_calls_total": "counter",
     "ray_tpu_leased_tasks_total": "counter",
     "ray_tpu_lease_revocations_total": "counter",
+    # head fault tolerance (core/telemetry.py head-side)
+    "ray_tpu_head_restarts_total": "counter",
+    "ray_tpu_headless_seconds": "gauge",
+    "ray_tpu_resync_reports_total": "counter",
     # logging plane (core/worker_main.py)
     "ray_tpu_logs_dropped_total": "counter",
 }

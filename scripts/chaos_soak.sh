@@ -6,8 +6,9 @@
 # in-memory checkpoint recovery, and elastic gang resize.
 #
 # Usage:
-#   scripts/chaos_soak.sh [N]          # default N=5
+#   scripts/chaos_soak.sh [N]               # default N=5
 #   scripts/chaos_soak.sh --race-sentinel [N]
+#   scripts/chaos_soak.sh --head-kill [N]   # head SIGKILL+restart subset only
 #   CHAOS_PYTEST_ARGS="-k drain" scripts/chaos_soak.sh 10
 #
 # Rotating seeds: each iteration exports RT_CHAOS_SEED=<iter>, which the
@@ -19,23 +20,40 @@
 # ordering is checked transitively and each guarded dataplane field
 # rebind asserts its _RT_GUARDED_BY lock is held — so the SIGTERM chaos
 # interleavings double as a data-race hunt, not just a recovery test.
+#
+# --head-kill soaks only the head-crash drill (tests/test_head_crash.py):
+# an external head is SIGKILLed mid-workload and restarted with the same
+# port/session/state; the pass criteria are zero failed direct calls,
+# full field-state resync, and the headless suicide deadline.
 set -u -o pipefail
 
 LOCKS_LEVEL="${RT_DEBUG_LOCKS:-0}"
-if [ "${1:-}" = "--race-sentinel" ]; then
-    LOCKS_LEVEL=2
-    shift
-fi
+MODE="default"
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --race-sentinel) LOCKS_LEVEL=2; shift ;;
+        --head-kill) MODE="head-kill"; shift ;;
+        *) break ;;
+    esac
+done
 N="${1:-5}"
 cd "$(dirname "$0")/.."
 
+if [ "$MODE" = "head-kill" ]; then
+    TARGETS="tests/test_head_crash.py"
+    MARK="chaos"
+else
+    TARGETS="tests/test_fault_tolerance.py tests/test_chaos.py tests/test_head_crash.py"
+    MARK="chaos"
+fi
+
 fails=0
 for i in $(seq 1 "$N"); do
-    echo "=== chaos soak iteration $i/$N (RT_CHAOS_SEED=$i) ==="
+    echo "=== chaos soak iteration $i/$N (mode=$MODE RT_CHAOS_SEED=$i) ==="
     if ! env JAX_PLATFORMS=cpu RT_CHAOS_SEED="$i" \
         RT_DEBUG_LOCKS="$LOCKS_LEVEL" \
         timeout -k 10 600 python -m pytest -q \
-        -m chaos tests/test_fault_tolerance.py tests/test_chaos.py \
+        -m "$MARK" $TARGETS \
         -p no:cacheprovider -p no:randomly \
         ${CHAOS_PYTEST_ARGS:-}; then
         echo "!!! chaos soak FAILED on iteration $i (seed $i)"

@@ -16,6 +16,12 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # Deterministic TPU autodetect: the machine under test may expose real
 # /dev/accel* chips; tests that want chips mock them via RT_TPU_CHIPS.
 os.environ.setdefault("RT_TPU_CHIPS", "0")
+# Headless suicide deadline, shortened for tests: workers orphaned by
+# head-kill tests (test_head_crash, test_head_kill9, workflow restarts)
+# redial the dead address until this deadline — at the 45 s production
+# default they'd linger across later tests and eat the tier-1 budget on
+# small CI boxes.  Tests that assert specific deadlines override it.
+os.environ.setdefault("RT_HEAD_RECONNECT_DEADLINE_S", "8")
 
 # A sitecustomize hook (TPU tunnel) plus pytest plugins (jaxtyping) can
 # import jax and initialize the TPU backend before this conftest runs —
