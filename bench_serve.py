@@ -203,6 +203,48 @@ def bench_serve_path(n_requests: int = 16) -> Dict:
         ray_tpu.shutdown()
 
 
+def assert_trace_completeness(engine) -> Dict:
+    """Drive ONE force-sampled request through the engine and assert its
+    span tree contains every expected stage (queue -> prefill -> decode)
+    with TTFT reconstructable from the spans alone.  A propagation
+    regression (engine stops capturing the submitter's context, a stage
+    span vanishes) fails the slow gate here instead of surviving until
+    someone eyeballs a timeline.  Raises SystemExit on failure."""
+    from ray_tpu.util import tracing
+
+    tracing.drain_buffered()  # isolate this request's spans
+    n_tokens = 4
+    with tracing.trace("bench:request", force=True) as root:
+        stream = engine.submit([3, 5, 7], max_new_tokens=n_tokens)
+        for _ in stream:
+            pass
+    spans = [s for s in tracing.drain_buffered()
+             if s.get("trace_id") == root["trace_id"]]
+    by_name = {s["name"]: s for s in spans}
+    missing = {"engine:queue", "engine:prefill",
+               "engine:decode"} - set(by_name)
+    if missing:
+        raise SystemExit(
+            f"trace completeness check FAILED: stages missing from the "
+            f"span tree: {sorted(missing)} (got {sorted(by_name)})")
+    for name in ("engine:queue", "engine:prefill", "engine:decode"):
+        if by_name[name].get("parent_id") != root["span_id"]:
+            raise SystemExit(
+                f"trace completeness check FAILED: {name} span not "
+                "parented into the request trace")
+    decode = by_name["engine:decode"]
+    if (decode.get("attrs") or {}).get("tokens") != n_tokens:
+        raise SystemExit(
+            "trace completeness check FAILED: decode span token count "
+            f"{(decode.get('attrs') or {}).get('tokens')} != {n_tokens}")
+    ttft_s = by_name["engine:prefill"]["end"] - by_name["engine:queue"]["start"]
+    if not ttft_s > 0:
+        raise SystemExit(
+            "trace completeness check FAILED: TTFT not reconstructable "
+            f"from spans (got {ttft_s})")
+    return {"stages": sorted(by_name), "ttft_s": round(ttft_s, 6)}
+
+
 def main(argv=None) -> Dict:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -230,6 +272,11 @@ def main(argv=None) -> Dict:
     caps: Dict[str, float] = {}
     for mode in ("continuous", "whole_request"):
         eng = _build_engine(mode)
+        if mode == "continuous":
+            # Trace-completeness gate (cheap: one 4-token request on the
+            # already-built engine): propagation regressions fail the
+            # bench, and therefore the slow CI gate, loudly.
+            report["trace_check"] = assert_trace_completeness(eng)
         trials = [measure_capacity(eng, n_cap, seed=t) for t in range(2)]
         caps[mode] = max(t["tokens_per_s"] for t in trials)
         report["capacity"][mode] = {

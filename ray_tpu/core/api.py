@@ -593,16 +593,9 @@ def _inject_trace(spec: dict) -> None:
             "task_span_id": task_span_id,
         }
         now = _time.time()
-        tracing.emit_span({
-            "trace_id": parent["trace_id"],
-            "span_id": tracing.new_id(),
-            "parent_id": parent["span_id"],
-            "name": f"submit:{spec.get('name', 'task')}",
-            "start": now,
-            "end": now,
-            "pid": os.getpid(),
-            "attrs": {"flow_id": task_span_id},
-        })
+        tracing.emit_span(tracing.make_span(
+            parent, f"submit:{spec.get('name', 'task')}", now, now,
+            flow_id=task_span_id))
 
 
 def _resources_from_options(o: dict, default_cpu: float = 1.0) -> Dict[str, float]:

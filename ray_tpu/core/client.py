@@ -66,6 +66,10 @@ class Client:
                              "reconnect path (under _reconnect_lock); the "
                              "worker's reconnect thread polls it and a "
                              "stale None read just retries once more",
+        "trace_sample_rate": "head-config publication at (re)register "
+                             "(init, then under _reconnect_lock); tracing "
+                             "readers tolerate a stale value for one "
+                             "sampling decision",
     }
 
     def __init__(
@@ -140,6 +144,10 @@ class Client:
         self.proxy: bool = bool(reply.get("proxy"))
         if self.proxy:
             self.session = f"{self.session}-proxy{os.getpid()}"
+        # Head-configured root-trace sampling rate (util/tracing.py reads
+        # it at every trace root): one knob on the head governs the whole
+        # cluster.  None -> fall back to this process's local config.
+        self.trace_sample_rate = reply.get("trace_sample_rate")
         self.kind = kind
         # Per-session store clients: created lazily from whatever thread
         # first touches a session (user threads, push handlers on the rpc
@@ -217,6 +225,13 @@ class Client:
                 return  # superseded by a newer session's client
             try:
                 oref._flush_free_queue(background=True)
+                # Span plane: drain the process-local span ring into one
+                # batched span_batch entry — the existing background-report
+                # cadence IS the span flush cadence (and while headless the
+                # batch buffers for replay like task_done reports).
+                from ray_tpu.util import tracing as _tracing
+
+                _tracing.flush_spans(self)
                 # Safety net: batched calls must not sit forever in a driver
                 # that stops making client calls (e.g. waits on side effects).
                 self._flush_submit_batch()
@@ -1195,6 +1210,8 @@ class Client:
             # second reconnect loop.  (The old client's attribute still
             # holds the owner's callback: close() nulls it after the swap.)
             rpc.on_connection_lost = self.rpc.on_connection_lost
+            self.trace_sample_rate = reply.get(
+                "trace_sample_rate", self.trace_sample_rate)
         except Exception:
             if os.environ.get("RT_DEBUG_RPC_ERR"):
                 import sys as _sys
@@ -1290,6 +1307,18 @@ class Client:
         return True
 
     def close(self):
+        try:
+            # Final span flush: a short-lived driver's trailing spans must
+            # not die in the ring (only for the session's active client —
+            # a tooling client closing must not steal another's spans).
+            from .context import ctx as _ctx
+
+            if _ctx.client is self:
+                from ..util import tracing as _tracing
+
+                _tracing.flush_spans(self)
+        except BaseException:  # noqa: BLE001 — shutdown is best-effort
+            pass
         try:
             self.drain_bg(timeout=5.0)
         except BaseException:  # noqa: BLE001 — shutdown is best-effort

@@ -219,6 +219,16 @@ class Config:
     # -- observability --------------------------------------------------------
     task_events_buffer_size: int = 100_000
     enable_timeline: bool = True
+    # Root-trace sampling rate, handed to every registering process in the
+    # register reply (the head is the config source, so ONE knob governs
+    # the whole cluster): 1.0 traces every root span, 0 disables tracing;
+    # tracing.trace(..., force=True) is the per-call override.
+    trace_sample_rate: float = 1.0
+    # Per-process bounded span ring (util/tracing.py): finished spans
+    # buffer here and flush as one batched span_batch RPC on the
+    # background-report cadence; overflow drops (counted in
+    # ray_tpu_spans_dropped_total), never blocks the emitting thread.
+    span_ring_size: int = 4096
     # Per-process metrics flusher cadence (util/metrics.py).  An atexit hook
     # ships the final window regardless, so short-lived workers don't lose
     # their last deltas.

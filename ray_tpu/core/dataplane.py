@@ -823,6 +823,17 @@ class Dataplane:
             retries = spec.get("max_retries", 0)
             if retries > 0:
                 spec["max_retries"] = retries - 1
+        injected = spec.get("trace_ctx")
+        if injected is not None:
+            # The degrade is part of the request's story: a zero-length
+            # marker span makes the peer->head re-route visible in the
+            # trace (buffered emission — no head RPC from this path).
+            from ..util import tracing
+
+            now = time.time()
+            tracing.emit_span(tracing.make_span(
+                injected, f"reroute:{spec.get('name', 'task')}", now, now,
+                to="head", retry_charged=bool(decrement_retries)))
         method = "submit_actor_task" if call.kind == "actor" \
             else "submit_task"
         try:

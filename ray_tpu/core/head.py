@@ -394,7 +394,7 @@ class Head:
             "ping", "shutdown_cluster",
             "restore_object", "store_stats",
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
-            "node_health_ack", "node_stats", "node_drain", "span",
+            "node_health_ack", "node_stats", "node_drain", "span_batch",
             "get_log", "stack_dump", "stack_dump_reply",
             "resolve_actor", "lease_request", "lease_return", "lease_renew",
             "direct_done",
@@ -1084,7 +1084,8 @@ class Head:
                 await self._resync_worker_adopt(w, body)
                 self._note_resync("worker", worker_id.hex())
                 self._kick()
-            return {"session": self.session}
+            return {"session": self.session,
+                    "trace_sample_rate": self.config.trace_sample_rate}
         if kind == "node":
             node_id = NodeID(body["node_id"]) if body.get("node_id") else NodeID.from_random()
             if node_id not in self.scheduler.nodes:
@@ -1116,7 +1117,8 @@ class Head:
                 self._note_resync("node", node_id.hex(),
                                   headless_s=resync.get("headless_s"))
             self._kick()
-            return {"session": self.session, "node_id": node_id.binary()}
+            return {"session": self.session, "node_id": node_id.binary(),
+                    "trace_sample_rate": self.config.trace_sample_rate}
         # Drivers on the head host attach its shm session for zero-copy
         # reads.  A driver on another machine gets PROXY mode instead (the
         # Ray Client role — reference: python/ray/util/client/, ray_client
@@ -1132,7 +1134,8 @@ class Head:
             conn.meta["kind"] = kind  # driver (proxied)
             conn.meta["pid"] = body.get("pid")
             conn.meta["proxy"] = True
-            return {"session": self.session, "proxy": True}
+            return {"session": self.session, "proxy": True,
+                    "trace_sample_rate": self.config.trace_sample_rate}
         conn.meta["kind"] = kind  # driver
         conn.meta["pid"] = body.get("pid")
         conn.meta["reader_node"] = self.local_node_id
@@ -1147,6 +1150,9 @@ class Head:
         return {
             "session": self.session,
             "node_id": self.local_node_id.binary() if self.local_node_id else b"",
+            # Head-configured root sampling rate: one cluster-wide knob
+            # (util/tracing.py rolls it at every trace root).
+            "trace_sample_rate": self.config.trace_sample_rate,
         }
 
     # -- field-state resync (head restart survival) ---------------------------
@@ -2829,22 +2835,29 @@ class Head:
             w.last_ack = time.monotonic()
         return {}
 
-    async def h_span(self, conn, body):
-        """Finished tracing span from any process -> timeline ring
-        (reference: task events flow to GcsTaskManager via
-        task_event_buffer.h; `ray timeline` reads them back)."""
-        self._event("span", **{k: body.get(k) for k in (
-            "trace_id", "span_id", "parent_id", "name", "start", "end",
-            "pid", "attrs",
-        )})
-        # Task execution spans feed the built-in duration histogram — the
-        # trace↔metrics link: the same span that draws the timeline bar
-        # contributes to ray_tpu_task_duration_seconds.
-        start, end = body.get("start"), body.get("end")
-        if (str(body.get("name", "")).startswith("task:")
-                and isinstance(start, (int, float))
-                and isinstance(end, (int, float)) and end >= start):
-            self.builtin_metrics.task_duration.observe(end - start)
+    async def h_span_batch(self, conn, body):
+        """Batched finished tracing spans from any process -> timeline
+        ring (reference: task events flow to GcsTaskManager via
+        task_event_buffer.h in batches; `ray timeline` reads them back).
+        One RPC carries a whole ring flush — the span plane never pays a
+        head dispatch per span; malformed entries are skipped so one bad
+        emitter can't drop a process's whole batch."""
+        for span in body["spans"]:
+            if not isinstance(span, dict) or not span.get("trace_id") \
+                    or not span.get("span_id"):
+                continue
+            self._event("span", **{k: span.get(k) for k in (
+                "trace_id", "span_id", "parent_id", "name", "start", "end",
+                "pid", "attrs",
+            )})
+            # Task execution spans feed the built-in duration histogram —
+            # the trace↔metrics link: the same span that draws the
+            # timeline bar contributes to ray_tpu_task_duration_seconds.
+            start, end = span.get("start"), span.get("end")
+            if (str(span.get("name", "")).startswith("task:")
+                    and isinstance(start, (int, float))
+                    and isinstance(end, (int, float)) and end >= start):
+                self.builtin_metrics.task_duration.observe(end - start)
         return {}
 
     async def h_node_stats(self, conn, body):
@@ -3968,6 +3981,37 @@ class Head:
             return {"items": items}
         if kind == "timeline":
             return {"items": list(self.task_events)}
+        if kind == "traces":
+            # Span plane query surface: with trace_id (hex prefix ok),
+            # the trace's raw spans; without, per-trace summary rows —
+            # what `ray_tpu trace` and the dashboard's traces tab read.
+            spans = [e for e in self.task_events if e.get("kind") == "span"]
+            tid = body.get("trace_id")
+            if tid:
+                matched: Dict[str, list] = {}
+                for s in spans:
+                    sid = str(s.get("trace_id", ""))
+                    if sid.startswith(str(tid)):
+                        matched.setdefault(sid, []).append(s)
+                if not matched:
+                    return {"items": []}
+                # A short hex prefix can match several traces: NEVER merge
+                # them into one bogus tree — serve the most recent match
+                # and name the others so the caller can disambiguate.
+                pick = max(
+                    matched,
+                    key=lambda t: max(
+                        (s.get("start") or 0) for s in matched[t]),
+                ) if len(matched) > 1 else next(iter(matched))
+                reply: Dict[str, Any] = {"items": matched[pick]}
+                if len(matched) > 1:
+                    reply["ambiguous_matches"] = sorted(matched)
+                return reply
+            from ..util import trace_analysis
+
+            limit = body.get("limit")
+            return {"items": trace_analysis.summarize(
+                spans, limit=int(limit) if limit else 100)}
         if kind == "logs":
             # Cluster-wide log index, exited processes included (their
             # entries are what crash post-mortems route through).

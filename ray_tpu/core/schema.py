@@ -77,7 +77,10 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "node_health_ack": (("node_id", _BYTES),),
     "node_stats": (("node_id", _BYTES),),
     "node_drain": (("node_id", _BYTES),),
-    "span": (("trace_id", str), ("span_id", str), ("name", str)),
+    # Batched span plane: finished tracing spans ship in one body (each
+    # entry needs trace_id/span_id/name; the handler skips malformed
+    # entries instead of failing the batch).
+    "span_batch": (("spans", list),),
     "metrics_report": (("pid", _NUM), ("rows", list)),
     "pg_ready": (("pg_id", _BYTES),),
     "read_log": (("path", str),),

@@ -1213,6 +1213,12 @@ class Worker:
             for stream in (sys.stdout, sys.stderr):
                 if isinstance(stream, _LogTee):
                     stream.flush_residual()
+            # Trailing spans (the final task's execution span lands in the
+            # ring AFTER its task_done) must not die with the process.
+            from ..util import tracing as _tracing
+
+            _tracing.flush_spans(self.client)
+            self.client._flush_submit_batch()
             from ray_tpu.util.metrics import _final_flush
 
             _final_flush()
@@ -1280,6 +1286,11 @@ class Worker:
             except queue.Empty:
                 # Idle: completed-task reports must not sit in the batch
                 # (their callers block until the head processes them).
+                # Spans flush first so a finished task's execution span
+                # rides the same coalesced head RPC as its task_done.
+                from ..util import tracing as _tracing
+
+                _tracing.flush_spans(self.client)
                 self.client._flush_submit_batch()
                 continue
             is_method = bool(spec.get("method_name"))

@@ -16,6 +16,9 @@ Endpoints:
     /api/task_events -> per-task lifecycle histories (transitions +
                         failure tracebacks, retained past worker death)
     /api/logs     -> the cluster log index (exited processes included)
+    /api/traces   -> per-trace summary rows from the span plane (trace id,
+                     root span, span count, duration) — drill in via
+                     `python -m ray_tpu trace <id>`
     /api/log?proc=<id>[&offset=N][&max_bytes=N] -> raw log content,
                      routed head -> owning node (negative offset = tail)
     /api/metrics/history -> retained time series per (metric, tags):
@@ -41,6 +44,7 @@ from typing import Optional
 _STATE_KINDS = (
     "nodes", "actors", "tasks", "workers", "objects",
     "placement_groups", "timeline", "metrics", "task_events", "logs",
+    "traces",
 )
 
 _PAGE = """<!doctype html>
@@ -80,7 +84,7 @@ _PAGE = """<!doctype html>
 <script>
 const TABS = ["status","nodes","actors","tasks","workers","objects",
               "placement_groups","jobs","metrics","history","summary",
-              "task_events","logs"];
+              "task_events","logs","traces"];
 let tab = location.hash.slice(1) || "status";
 const nav = document.getElementById("nav");
 TABS.forEach(t => {

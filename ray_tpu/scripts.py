@@ -217,6 +217,56 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Per-request trace analysis (the span-plane query surface): without
+    an id, lists recent traces; with a trace id (hex prefix ok), prints
+    the ASCII waterfall, the critical path, and the per-stage latency
+    breakdown; ``--chrome`` exports that one trace as chrome://tracing
+    JSON with the submit->execute flow arrows."""
+    cl = _client(args.address)
+    try:
+        if not args.trace_id:
+            items = cl.call("list_state", {"kind": "traces"})["items"]
+            if args.json:
+                print(json.dumps(items, indent=1, default=str))
+            else:
+                _print_table(
+                    items,
+                    ["trace_id", "root", "spans", "start", "duration_s"],
+                    empty="(no traces)")
+            return 0
+        reply = cl.call(
+            "list_state", {"kind": "traces", "trace_id": args.trace_id})
+        spans = reply["items"]
+        ambiguous = reply.get("ambiguous_matches")
+        if ambiguous:
+            print(
+                f"note: prefix {args.trace_id!r} matches "
+                f"{len(ambiguous)} traces — showing the most recent "
+                f"({spans[0].get('trace_id', '?')}); others: "
+                + " ".join(t[:16] for t in ambiguous[:8]),
+                file=sys.stderr)
+        if not spans:
+            print(f"(no spans for trace {args.trace_id!r} — sampled out, "
+                  "expired from the timeline ring, or wrong id)",
+                  file=sys.stderr)
+            return 1
+        if getattr(args, "chrome", False):
+            from .util.tracing import chrome_trace
+
+            print(json.dumps(chrome_trace(spans)))
+            return 0
+        if args.json:
+            print(json.dumps(spans, indent=1, default=str))
+            return 0
+        from .util import trace_analysis
+
+        print(trace_analysis.format_trace(spans))
+    finally:
+        cl.close()
+    return 0
+
+
 def cmd_logs(args) -> int:
     """Cluster log retrieval (reference: `ray logs`).  Without an id, lists
     the head's log index — including EXITED processes, whose files stay
@@ -490,6 +540,21 @@ def main(argv=None) -> int:
     p.add_argument("--chrome", action="store_true",
                    help="emit chrome://tracing span JSON")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "trace",
+        help="per-request trace: waterfall, critical path, stage "
+             "breakdown",
+    )
+    p.add_argument("trace_id", nargs="?", default=None,
+                   help="trace id (hex prefix ok); omit to list recent "
+                        "traces")
+    p.add_argument("--chrome", action="store_true",
+                   help="emit this trace as chrome://tracing JSON (flow "
+                        "arrows included)")
+    p.add_argument("--json", action="store_true",
+                   help="raw span dicts / summary rows")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("serve", help="declarative serve operations")
     p.add_argument("action", choices=["deploy", "status", "shutdown"])

@@ -199,7 +199,8 @@ class _HttpProxy:
         handles: Dict[tuple, DeploymentHandle] = {}
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def _stream_sse(self, gen_handle: DeploymentHandle, payload):
+            def _stream_sse(self, gen_handle: DeploymentHandle, payload,
+                            trace_id=None):
                 """Server-sent events over a generator deployment
                 (reference: proxy.py:537-598 — the HTTP proxy streams
                 responses chunk-by-chunk as the replica produces them).
@@ -216,6 +217,10 @@ class _HttpProxy:
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                if trace_id:
+                    # Request-tracing handshake: the client can feed this
+                    # straight to `python -m ray_tpu trace <id>`.
+                    self.send_header("X-RT-Trace-Id", trace_id)
                 self.end_headers()
                 completed = False
                 try:
@@ -247,9 +252,12 @@ class _HttpProxy:
                         stream.cancel()
 
             def do_POST(self):  # noqa: N802 — stdlib naming
+                from ray_tpu.util import tracing
+
                 name = self.path.strip("/").split("/")[0]
                 want_stream = "text/event-stream" in (
                     self.headers.get("Accept") or "")
+                trace_id = None
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n)
@@ -262,15 +270,26 @@ class _HttpProxy:
                     if h is None:
                         h = handles[key] = DeploymentHandle(
                             name, stream=want_stream)
-                    if want_stream:
-                        self._stream_sse(h, payload)
-                        return
-                    if isinstance(payload, dict):
-                        resp = h.remote(**payload).result()
-                    elif payload is None:
-                        resp = h.remote().result()
-                    else:
-                        resp = h.remote(payload).result()
+                    # Per-request root span (sampling per the head's
+                    # trace_sample_rate): the whole serve chain — handle,
+                    # replica, engine — nests under it, so one trace id
+                    # answers "where did this request's latency go".
+                    # X-RT-Force-Trace: 1 is the per-call override.
+                    force = (self.headers.get("X-RT-Force-Trace") or "") \
+                        in ("1", "true")
+                    with tracing.trace(f"ingress:{name}", force=force,
+                                       proto="http",
+                                       stream=want_stream) as tctx:
+                        trace_id = tctx.get("trace_id")
+                        if want_stream:
+                            self._stream_sse(h, payload, trace_id)
+                            return
+                        if isinstance(payload, dict):
+                            resp = h.remote(**payload).result()
+                        elif payload is None:
+                            resp = h.remote().result()
+                        else:
+                            resp = h.remote(payload).result()
                     out = json.dumps(resp).encode()
                     self.send_response(200)
                 except Exception as e:  # noqa: BLE001 — surfaces as a 500
@@ -278,6 +297,8 @@ class _HttpProxy:
                     self.send_response(500)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(out)))
+                if trace_id:
+                    self.send_header("X-RT-Trace-Id", trace_id)
                 self.end_headers()
                 self.wfile.write(out)
 

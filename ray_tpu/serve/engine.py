@@ -98,6 +98,8 @@ class _Request:
         "out_q", "cancelled", "finished", "pages", "page_table",
         "length", "generated", "submit_t", "first_token_t",
         "last_token_t", "itls", "slot",
+        "trace_ctx", "submit_wall", "admit_wall", "first_wall",
+        "prefill_bucket",
     )
 
     def __init__(self, req_id: int, prompt: np.ndarray, max_new: int,
@@ -121,6 +123,16 @@ class _Request:
         # they reflect decode cadence, not consumer scheduling.
         self.itls: List[float] = []
         self.slot = -1
+        # Tracing: the submitter's span context (None when the request
+        # arrived untraced/unsampled — then the engine emits nothing) plus
+        # wall-clock transition stamps for the queue/prefill/decode spans
+        # (submit_t/first_token_t are perf_counter and can't be shared
+        # with wall-clocked spans from other processes).
+        self.trace_ctx: Optional[Dict[str, str]] = None
+        self.submit_wall = 0.0
+        self.admit_wall = 0.0
+        self.first_wall = 0.0
+        self.prefill_bucket = 0
 
 
 class TokenStream:
@@ -282,6 +294,13 @@ class InferenceEngine:
             self._req_counter += 1
             req = _Request(self._req_counter, prompt, max_new,
                            float(temperature), stop_token)
+            # Capture the submitter's trace context (the replica's
+            # execution span in the serve path): the loop thread emits
+            # this request's queue/prefill/decode spans against it.
+            from ..util import tracing
+
+            req.trace_ctx = tracing.context_for_submit()
+            req.submit_wall = time.time()
             self._pending.append(req)
             self._m_queue.set(len(self._pending), tags=self._pid_tags)
             self._wake.notify()
@@ -361,6 +380,7 @@ class InferenceEngine:
             if pages is None:
                 break  # pool pressure: leave queued, retry next step
             self._pending.pop(0)
+            req.admit_wall = time.time()
             req.pages = pages
             pt = np.full((self.maxp,), self.scratch, np.int32)
             pt[:need] = pages
@@ -372,9 +392,32 @@ class InferenceEngine:
             self._m_queue.set(len(self._pending), tags=self._pid_tags)
         return admitted
 
+    def _emit_req_span(self, req: _Request, name: str, start: float,
+                       end: float, **attrs) -> None:
+        """One request-stage span (queue / prefill / decode), parented to
+        the submitter's context.  Buffered emission (util/tracing ring) —
+        the decode loop never pays a head RPC for tracing."""
+        if req.trace_ctx is None or start <= 0:
+            return
+        from ..util import tracing
+
+        tracing.emit_span(
+            tracing.make_span(req.trace_ctx, name, start, end, **attrs))
+
     def _evict(self, slot: int, reason: str) -> None:
         req = self.slots[slot]
         assert req is not None
+        # Decode-lifetime span: first token -> eviction.  Token count,
+        # TTFT, and mean ITL ride as attrs so per-request latency
+        # attribution is derivable from the span tree alone.
+        now_wall = time.time()
+        self._emit_req_span(
+            req, "engine:decode", req.first_wall or req.admit_wall,
+            now_wall, tokens=req.generated, reason=reason,
+            ttft_s=round(req.first_token_t - req.submit_t, 6)
+            if req.first_token_t is not None else None,
+            mean_itl_s=round(sum(req.itls) / len(req.itls), 6)
+            if req.itls else None)
         self.allocator.free(req.pages)
         req.pages = []
         req.finished = True
@@ -407,6 +450,12 @@ class InferenceEngine:
 
         n = req.prompt.size
         s_pad = self._bucket_len(n)
+        req.prefill_bucket = s_pad
+        # Queue-wait span (submit -> admission into a batch slot).
+        self._emit_req_span(req, "engine:queue", req.submit_wall,
+                            req.admit_wall or req.submit_wall,
+                            prompt_len=int(n))
+        pf_start = time.time()
         toks = np.zeros((1, s_pad), np.int32)
         toks[0, :n] = req.prompt
         first, self._d_key, self.pools = paged_prefill(
@@ -419,6 +468,11 @@ class InferenceEngine:
         req.length = n
         req.first_token_t = now
         req.last_token_t = now
+        req.first_wall = time.time()
+        # Prefill span, bucket attr included: bucket-vs-prompt padding
+        # waste is readable straight off the trace.
+        self._emit_req_span(req, "engine:prefill", pf_start, req.first_wall,
+                            bucket=int(s_pad), prompt_len=int(n))
         self._m_prefill.inc(n)
         self._m_ttft.observe(now - req.submit_t)
         slot = req.slot
@@ -447,9 +501,15 @@ class InferenceEngine:
         queued — they retry against the fresh pool."""
         from ..models.paged import init_paged_pools
 
+        now_wall = time.time()
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
+            self._emit_req_span(
+                req, "engine:decode",
+                req.first_wall or req.admit_wall or req.submit_wall,
+                now_wall, tokens=req.generated, reason="error",
+                error=repr(exc)[:200])
             self.allocator.free(req.pages)
             req.pages = []
             req.finished = True

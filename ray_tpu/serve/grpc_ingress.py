@@ -58,11 +58,28 @@ class _GrpcIngress:
                           f"{type(e).__name__}: {e}")
 
         def call(request: bytes, context):
+            from ray_tpu.util import tracing
+
             req, h = _route(request, context)
             try:
-                result = h.remote(
-                    *(req.get("args") or []), **(req.get("kwargs") or {})
-                ).result()
+                # Per-request root span (head-configured sampling;
+                # "force_trace": true in the body is the per-call
+                # override); the trace id travels back in the trailing
+                # metadata for `python -m ray_tpu trace <id>`.
+                with tracing.trace(
+                    f"ingress:{req['deployment']}",
+                    force=bool(req.get("force_trace")), proto="grpc",
+                ) as tctx:
+                    # Metadata set BEFORE the call: a failing request —
+                    # the one worth `ray_tpu trace`-ing — must still
+                    # return its trace id with the error status.
+                    if tctx.get("trace_id"):
+                        context.set_trailing_metadata(
+                            (("x-rt-trace-id", tctx["trace_id"]),))
+                    result = h.remote(
+                        *(req.get("args") or []),
+                        **(req.get("kwargs") or {})
+                    ).result()
                 # Serialize inside the mapping too: a non-JSON result
                 # (arrays, bytes) must answer INTERNAL with the reason,
                 # not a blank UNKNOWN.
@@ -115,12 +132,37 @@ class _GrpcIngress:
             stream is pulled item-by-item (consumer-side buffering is one
             item; the rest waits in the object store), so a slow client
             applies backpressure to this worker thread only."""
+            import os
+            import time
+
+            from ray_tpu.util import tracing
+
             req, h = _route(request, context, stream=True)
             stream = None
             completed = False
+            # Root span WITHOUT the trace() context manager: this is a
+            # generator the gRPC server may resume on different pool
+            # threads, and a contextvar held across yields would leak the
+            # request's context into unrelated work on the opening
+            # thread.  Install the context only around the same-thread
+            # submission (where propagation happens); emit the ingress
+            # span manually at finalization.
+            span_ctx = None
+            start = time.time()
+            if tracing.should_sample(bool(req.get("force_trace"))):
+                span_ctx = {"trace_id": tracing.new_id(),
+                            "span_id": tracing.new_id()}
+                context.set_trailing_metadata(
+                    (("x-rt-trace-id", span_ctx["trace_id"]),))
             try:
-                stream = h.remote(
-                    *(req.get("args") or []), **(req.get("kwargs") or {}))
+                token = tracing.set_context(span_ctx) if span_ctx else None
+                try:
+                    stream = h.remote(
+                        *(req.get("args") or []),
+                        **(req.get("kwargs") or {}))
+                finally:
+                    if token is not None:
+                        tracing.reset_context(token)
                 for item in stream:
                     if not context.is_active():
                         return  # client cancelled between frames
@@ -130,6 +172,18 @@ class _GrpcIngress:
             except Exception as e:  # noqa: BLE001 — mapped to a status
                 _abort_for(e, context)
             finally:
+                if span_ctx is not None:
+                    tracing.emit_span({
+                        "trace_id": span_ctx["trace_id"],
+                        "span_id": span_ctx["span_id"],
+                        "parent_id": None,
+                        "name": f"ingress:{req['deployment']}",
+                        "start": start,
+                        "end": time.time(),
+                        "pid": os.getpid(),
+                        "attrs": {"proto": "grpc", "stream": True,
+                                  "completed": completed},
+                    })
                 # Any non-complete exit — the is_active() poll, a client
                 # cancellation surfacing AT the yield (grpc closes this
                 # generator: GeneratorExit, a BaseException), or an abort

@@ -239,22 +239,36 @@ class DeploymentHandle:
                 model_id=self.multiplexed_model_id,
             )
 
-        try:
-            ref = submit(replica)
-        except Exception:
-            done()
-            # Replica likely died: force-refresh and retry once.
-            self._refresh(force=True)
-            with self._lock:
-                if not self._replicas:
-                    raise
-                idx = self._pick()
-                replica = self._replicas[idx]
-                self._local_load[idx] = self._local_load.get(idx, 0) + 1
-                # done() must release THIS replica's count, not the dead
-                # one's (already released above).
-                state["idx"] = idx
-            ref = submit(replica)
+        # Routing span: parents the replica's execution span to the
+        # ingress trace and records which replica the p2c pick chose; the
+        # replica queue-wait then reads off the trace as the gap between
+        # this span and the execution span.  Propagation-only — an
+        # untraced caller (no ingress span, no user trace) pays nothing;
+        # roots come from the ingress or an explicit tracing.trace().
+        from ..util import tracing
+
+        with tracing.trace_if_active(f"handle:{self.deployment_name}",
+                                     stream=self.stream) as hspan:
+            try:
+                ref = submit(replica)
+            except Exception:
+                done()
+                # Replica likely died: force-refresh and retry once.
+                self._refresh(force=True)
+                with self._lock:
+                    if not self._replicas:
+                        raise
+                    idx = self._pick()
+                    replica = self._replicas[idx]
+                    self._local_load[idx] = self._local_load.get(idx, 0) + 1
+                    # done() must release THIS replica's count, not the
+                    # dead one's (already released above).
+                    state["idx"] = idx
+                ref = submit(replica)
+            # Late attr: the FINAL pick — the in-span retry may have
+            # re-routed off a dead replica, and the trace must name the
+            # replica that actually got the request.
+            hspan["attrs"] = {"replica": state["idx"]}
 
         def retry():
             self._refresh(force=True)

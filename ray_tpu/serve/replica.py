@@ -75,6 +75,7 @@ class ServeReplica:
         import contextlib
         import time as _time
 
+        from ..util import tracing
         from .multiplex import _reset_model_id, _set_model_id
 
         @contextlib.contextmanager
@@ -85,7 +86,16 @@ class ServeReplica:
             token = _set_model_id(model_id)
             start = _time.perf_counter()
             try:
-                yield
+                # Per-request replica span: nests under the propagated
+                # execution span when the caller traced (ingress, handle,
+                # or an explicit tracing.trace) — the engine's
+                # queue/prefill/decode tree hangs off it.  Propagation-
+                # only: untraced/unsampled requests stay span-free.
+                with tracing.trace_if_active(
+                    f"replica:{self.deployment_name}",
+                    **({"model_id": model_id} if model_id else {}),
+                ):
+                    yield
             finally:
                 self._m_latency.observe(_time.perf_counter() - start,
                                         tags=self._m_tags)

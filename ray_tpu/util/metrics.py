@@ -76,6 +76,9 @@ BUILTIN_METRICS: Dict[str, str] = {
     "ray_tpu_resync_reports_total": "counter",
     # logging plane (core/worker_main.py)
     "ray_tpu_logs_dropped_total": "counter",
+    # tracing span plane (util/tracing.py): batched flushes + visible drops
+    "ray_tpu_spans_emitted_total": "counter",
+    "ray_tpu_spans_dropped_total": "counter",
 }
 
 _registry_lock = threading.Lock()

@@ -6,7 +6,23 @@ injects the active span context into task specs; spans wrap submission and
 execution) — re-designed without an OTel dependency: trace context is a
 (trace_id, span_id) pair carried in the task spec, spans are recorded into
 the head's timeline ring (task_event_buffer.h's role) and exported as a
-Chrome trace by ``python -m ray_tpu timeline --chrome``.
+Chrome trace by ``python -m ray_tpu timeline --chrome`` (or per-trace via
+``python -m ray_tpu trace <id> --chrome``).
+
+Emission is a **batched span plane**: finished spans buffer in a bounded
+per-process ring (``span_ring_size``) and flush as ONE ``span_batch`` head
+RPC on the background-report cadence — never one RPC per span.  The flush
+rides the client's ``call_batched`` machinery, so spans coalesce with
+task_done reports and, while the head connection is down, buffer and
+replay at reconnect exactly like completion reports (head-restart safe).
+Ring overflow and flush failures are counted in
+``ray_tpu_spans_dropped_total`` and logged once per process — drops are
+visible, never silent.
+
+Root spans roll a head-configured sample rate (``trace_sample_rate``,
+handed to every process in the register reply); ``trace(..., force=True)``
+is the per-call override.  Inside an unsampled root, nested spans and
+task submissions stay span-free end to end (zero propagation overhead).
 
 Usage::
 
@@ -21,33 +37,219 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import logging
 import os
+import threading
 import time
-from typing import Any, Dict, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional
 
-_current: contextvars.ContextVar[Optional[Dict[str, str]]] = (
+from ..core.ids import _rand_bytes
+
+_current: contextvars.ContextVar[Optional[Dict[str, Any]]] = (
     contextvars.ContextVar("rt_trace_ctx", default=None)
 )
+
+#: Sentinel context installed for an UNSAMPLED trace root: nested
+#: ``trace()`` calls and task submissions inside it emit nothing and
+#: propagate nothing, but the nesting discipline still holds.
+_UNSAMPLED: Dict[str, Any] = {"sampled": False}
+
+logger = logging.getLogger("ray_tpu.tracing")
 
 
 def new_id() -> str:
     """A fresh 64-bit hex span/trace id (public — use this instead of the
-    legacy private ``_new_id``)."""
-    return os.urandom(8).hex()
+    legacy private ``_new_id``).  Backed by the fork-keyed process PRNG
+    from ``core/ids`` — ``os.urandom`` is a syscall per call (~1 ms on
+    sandboxed kernels) and span ids are minted on the submission hot
+    path; the PRNG stream resets in forked children, so uniqueness holds
+    across zygote forks."""
+    return _rand_bytes(8).hex()
 
 
 _new_id = new_id  # backward-compat alias
 
 
-def current_context() -> Optional[Dict[str, str]]:
-    """The active {trace_id, span_id}, or None outside any trace."""
+# ------------------------------------------------------------- sampling
+
+
+def _sample_rate() -> float:
+    """Head-configured root sampling rate: the register reply carries the
+    head's ``trace_sample_rate`` (one knob governs the cluster); processes
+    without a client fall back to their local config."""
+    from ..core.context import ctx as rt_ctx
+
+    client = rt_ctx.client
+    rate = getattr(client, "trace_sample_rate", None) \
+        if client is not None else None
+    if rate is None:
+        try:
+            from ..core.config import get_config
+
+            rate = get_config().trace_sample_rate
+        except Exception:
+            rate = 1.0
+    return float(rate)
+
+
+def should_sample(force: bool = False) -> bool:
+    """Root-trace sampling decision.  ``force=True`` is the per-call
+    override (always traces); otherwise roll against the head-configured
+    rate."""
+    if force:
+        return True
+    rate = _sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return int.from_bytes(_rand_bytes(4), "little") < rate * 2.0**32
+
+
+# ------------------------------------------------------------- span ring
+
+_ring: deque = deque()
+_ring_lock = threading.Lock()
+_dropped_total = 0
+_warned_drop = False
+_m_emitted = None
+_m_dropped = None
+
+
+def _ring_cap() -> int:
+    try:
+        from ..core.config import get_config
+
+        return max(16, int(get_config().span_ring_size))
+    except Exception:
+        return 4096
+
+
+def _count_metric(which: str, n: int) -> None:
+    """Lazily-resolved counters (the metrics registry lock must not sit on
+    the emit path)."""
+    global _m_emitted, _m_dropped
+    try:
+        from .metrics import get_counter
+
+        if which == "emitted":
+            if _m_emitted is None:
+                _m_emitted = get_counter(
+                    "ray_tpu_spans_emitted_total",
+                    "Tracing spans shipped to the head (batched flush)")
+            _m_emitted.inc(n)
+        else:
+            if _m_dropped is None:
+                _m_dropped = get_counter(
+                    "ray_tpu_spans_dropped_total",
+                    "Tracing spans dropped (ring overflow or flush "
+                    "failure) — counted, never silent")
+            _m_dropped.inc(n)
+    except Exception:
+        pass  # metrics must never fail the span plane
+
+
+def _note_dropped(n: int, why: str) -> None:
+    global _dropped_total, _warned_drop
+    _dropped_total += n
+    _count_metric("dropped", n)
+    if not _warned_drop:
+        _warned_drop = True
+        logger.warning(
+            "dropping tracing spans (%s; %d so far, counted in "
+            "ray_tpu_spans_dropped_total) — raise span_ring_size or lower "
+            "trace_sample_rate if this persists", why, _dropped_total)
+
+
+def emit_span(span: Dict[str, Any]) -> None:
+    """Record a finished span: buffered into the process-local ring and
+    shipped in the next batched flush (NO per-span head RPC).  Public —
+    use this instead of the legacy private ``_emit``.  The span dict needs
+    at least trace_id/span_id/name; start/end are float timestamps in
+    seconds.  Ring overflow drops the span (counted), never blocks."""
+    with _ring_lock:
+        if len(_ring) < _ring_cap():
+            _ring.append(span)
+            return
+    _note_dropped(1, "span ring full")
+
+
+_emit = emit_span  # backward-compat alias
+
+
+def flush_spans(client=None) -> int:
+    """Drain the ring into ONE ``span_batch`` head RPC via the client's
+    ``call_batched`` — so span traffic coalesces with task_done reports.
+    While headless (lost head connection) this is a NO-OP: spans stay in
+    the BOUNDED ring (overflow drops counted) instead of growing the
+    client's held submit batch without limit for the whole outage, and
+    the first post-reconnect flush replays them.  Called from the
+    client's background flush loop (the existing report cadence), the
+    worker's idle loop, and the shutdown drains.  Returns the number of
+    spans flushed."""
+    if client is None:
+        from ..core.context import ctx as rt_ctx
+
+        client = rt_ctx.client
+    if client is None or getattr(client, "rpc", None) is None \
+            or getattr(client.rpc, "closed", False):
+        return 0
+    with _ring_lock:
+        if not _ring:
+            return 0
+        batch = list(_ring)
+        _ring.clear()
+    try:
+        client.call_batched("span_batch", {"spans": batch})
+    except Exception:
+        _note_dropped(len(batch), "span_batch flush failed")
+        return 0
+    _count_metric("emitted", len(batch))
+    return len(batch)
+
+
+def drain_buffered() -> List[Dict[str, Any]]:
+    """Remove and return every buffered (not-yet-flushed) span — for tests
+    and client-less diagnostics (bench harnesses assert span-tree
+    completeness this way)."""
+    with _ring_lock:
+        out = list(_ring)
+        _ring.clear()
+    return out
+
+
+# ------------------------------------------------------------- context
+
+
+def current_context() -> Optional[Dict[str, Any]]:
+    """The active {trace_id, span_id}, or None outside any trace.  Inside
+    an unsampled root this returns the unsampled sentinel."""
     return _current.get()
 
 
 def context_for_submit() -> Optional[Dict[str, str]]:
     """Trace context to inject into an outgoing task spec (reference:
-    _DictPropagator.inject_current_context)."""
-    return _current.get()
+    _DictPropagator.inject_current_context).  None outside any trace AND
+    inside an unsampled root — unsampled traces propagate nothing."""
+    ctx = _current.get()
+    if ctx is None or not ctx.get("sampled", True):
+        return None
+    return ctx
+
+
+def _safe_reset(token, installed=None) -> None:
+    """Reset the context var, tolerating a generator finalized on a
+    different thread than the one that opened the span (pool-driven
+    generators): the token then belongs to another thread's context.  In
+    that case clear ONLY if the finalizing thread's active context is
+    this very span — never wipe an unrelated concurrent request's
+    context."""
+    try:
+        _current.reset(token)
+    except ValueError:
+        if installed is not None and _current.get() is installed:
+            _current.set(None)
 
 
 def set_context(ctx: Optional[Dict[str, str]]):
@@ -60,29 +262,29 @@ def reset_context(token) -> None:
     _current.reset(token)
 
 
-def emit_span(span: Dict[str, Any]) -> None:
-    """Record a finished span into the cluster timeline (best-effort).
-    Public — use this instead of the legacy private ``_emit``.  The span
-    dict needs at least trace_id/span_id/name; start/end are float
-    timestamps in seconds."""
-    from ..core.context import ctx as rt_ctx
-
-    if rt_ctx.client is None:
-        return
-    try:
-        rt_ctx.client.call_bg("span", span)
-    except Exception:
-        pass
-
-
-_emit = emit_span  # backward-compat alias
-
-
 @contextlib.contextmanager
-def trace(name: str, **attrs):
+def trace(name: str, force: bool = False, **attrs):
     """A named span.  Nested spans and tasks submitted inside it become
-    children; the finished span lands in the cluster timeline."""
+    children; the finished span lands in the cluster timeline.  Root
+    spans roll the head-configured ``trace_sample_rate``; ``force=True``
+    always traces this root (the per-call override).  Extra keyword
+    arguments become span attrs."""
     parent = _current.get()
+    if parent is not None and not parent.get("sampled", True):
+        # Inside an unsampled root: stay span-free, keep the sentinel.
+        yield parent
+        return
+    if parent is None and not should_sample(force):
+        # Fresh dict per root (not the shared sentinel): callers may
+        # write into the yielded ctx's "attrs" (see below) and must not
+        # poison other traces.
+        unsampled = {"sampled": False}
+        token = _current.set(unsampled)
+        try:
+            yield unsampled
+        finally:
+            _safe_reset(token, unsampled)
+        return
     span_ctx = {
         "trace_id": parent["trace_id"] if parent else new_id(),
         "span_id": new_id(),
@@ -92,7 +294,13 @@ def trace(name: str, **attrs):
     try:
         yield span_ctx
     finally:
-        _current.reset(token)
+        _safe_reset(token, span_ctx)
+        # Late attrs: values the caller only learns inside the span (the
+        # handle's final replica pick after a retry) merge over the
+        # entry-time kwargs via the yielded ctx's "attrs" key.
+        late = span_ctx.get("attrs")
+        if late:
+            attrs = {**attrs, **late}
         emit_span({
             "trace_id": span_ctx["trace_id"],
             "span_id": span_ctx["span_id"],
@@ -105,7 +313,37 @@ def trace(name: str, **attrs):
         })
 
 
-def task_span(spec: Dict[str, Any], start: float, end: float) -> Optional[dict]:
+def make_span(parent_ctx: Dict[str, str], name: str, start: float,
+              end: float, **attrs) -> Dict[str, Any]:
+    """Build a finished-span dict against an explicit parent context —
+    for emitters that can't use the ``trace()`` context manager (the
+    engine's loop thread stamping another thread's request, the
+    dataplane's reroute marker).  Pair with :func:`emit_span`."""
+    return {
+        "trace_id": parent_ctx["trace_id"],
+        "span_id": new_id(),
+        "parent_id": parent_ctx.get("span_id"),
+        "name": name,
+        "start": start,
+        "end": end,
+        "pid": os.getpid(),
+        **({"attrs": attrs} if attrs else {}),
+    }
+
+
+def trace_if_active(name: str, **attrs):
+    """``trace()`` only when a SAMPLED context is already active — the
+    propagation-only span the serve handle/replica layers use: untraced
+    or unsampled callers pay nothing and root nothing.  Yields a dict
+    either way; writes to its ``"attrs"`` key merge into the emitted
+    span (no-op when inactive)."""
+    if context_for_submit() is None:
+        return contextlib.nullcontext({})
+    return trace(name, **attrs)
+
+
+def task_span(spec: Dict[str, Any], start: float, end: float,
+              **attrs) -> Optional[dict]:
     """Build the execution span for a finished task from its spec's injected
     context (None when the submission wasn't traced and tracing isn't
     forced)."""
@@ -120,6 +358,7 @@ def task_span(spec: Dict[str, Any], start: float, end: float) -> Optional[dict]:
         "start": start,
         "end": end,
         "pid": os.getpid(),
+        **({"attrs": attrs} if attrs else {}),
     }
 
 

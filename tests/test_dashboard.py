@@ -55,7 +55,7 @@ def test_state_endpoints(dash_cluster):
     assert ray_tpu.get(c.ping.remote()) == 1
 
     for ep in ("nodes", "actors", "tasks", "workers", "objects",
-               "placement_groups", "metrics", "timeline"):
+               "placement_groups", "metrics", "timeline", "traces"):
         status, body = _get(f"{dash.url}/api/{ep}")
         assert status == 200, ep
         assert "items" in json.loads(body), ep

@@ -248,12 +248,48 @@ def test_head_kill_node_manifest_and_named_actor_adoption(tmp_path, monkeypatch)
         assert ray_tpu.get(k.add.remote("pre"), timeout=60) == 1
 
         head.kill()
-        time.sleep(1.5)
+        time.sleep(0.5)
+        # Span emitted INSIDE the outage window: the batched span plane
+        # must hold it in the bounded ring (headless flush is a no-op)
+        # and replay it to the restarted head on the first post-reconnect
+        # flush — spans survive a head crash like task_done reports.
+        from ray_tpu.core.context import ctx as rt_ctx
+        from ray_tpu.util import tracing
+
+        # Wait for the driver to OBSERVE the dead connection (EOF on the
+        # reader) so the emit below is deterministically headless.
+        obs_deadline = time.monotonic() + 10
+        while not rt_ctx.client.rpc.closed \
+                and time.monotonic() < obs_deadline:
+            time.sleep(0.05)
+        assert rt_ctx.client.rpc.closed
+        with tracing.trace("during_outage", force=True) as outage_root:
+            pass
+        assert tracing.flush_spans(rt_ctx.client) == 0  # headless: held
+        time.sleep(1.0)
         head.restart()
 
         # The adopted actor kept its IN-MEMORY state: a fresh re-creation
         # from the snapshot would have restarted from [].
         assert ray_tpu.get(k.add.remote("post"), timeout=60) == 2
+        # The outage-window span replayed into the restarted head's
+        # timeline ring.
+        deadline = time.monotonic() + 20
+        names = set()
+        while time.monotonic() < deadline:
+            try:
+                spans = rt_ctx.client.call(
+                    "list_state",
+                    {"kind": "traces",
+                     "trace_id": outage_root["trace_id"]})["items"]
+            except Exception:
+                spans = []
+            names = {s.get("name") for s in spans}
+            if "during_outage" in names:
+                break
+            time.sleep(0.5)
+        assert "during_outage" in names, (
+            "span emitted while headless was lost across the restart")
         # The node's manifest replayed: the pre-crash object still reads.
         arr = ray_tpu.get(ref, timeout=60)
         assert int(arr[:3].sum()) == 3

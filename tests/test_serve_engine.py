@@ -252,6 +252,105 @@ def rt():
     ray_tpu.shutdown()
 
 
+def test_engine_request_span_tree(engine):
+    """A traced engine request emits the queue -> prefill -> decode span
+    tree (parented to the submitter's context) with bucket attr on the
+    prefill and token count + TTFT on the decode span — per-request
+    latency attribution derivable from spans alone.  Untraced requests
+    emit nothing."""
+    from ray_tpu.util import tracing
+
+    # Untraced submissions (no ambient context) must stay span-free.
+    tracing.drain_buffered()
+    for _ in engine.submit([5, 7], max_new_tokens=2):
+        pass
+    assert [s for s in tracing.drain_buffered()
+            if str(s.get("name", "")).startswith("engine:")] == []
+
+    with tracing.trace("req_root", force=True) as root:
+        stream = engine.submit([5, 7, 11], max_new_tokens=4)
+        toks = list(stream)
+    assert len(toks) == 4
+    spans = [s for s in tracing.drain_buffered()
+             if s.get("trace_id") == root["trace_id"]]
+    by_name = {s["name"]: s for s in spans}
+    assert {"engine:queue", "engine:prefill",
+            "engine:decode"} <= set(by_name), sorted(by_name)
+    for name in ("engine:queue", "engine:prefill", "engine:decode"):
+        assert by_name[name]["parent_id"] == root["span_id"]
+    prefill = by_name["engine:prefill"]
+    assert prefill["attrs"]["prompt_len"] == 3
+    assert prefill["attrs"]["bucket"] >= 3  # padded to a bucket
+    decode = by_name["engine:decode"]
+    assert decode["attrs"]["tokens"] == 4
+    assert decode["attrs"]["reason"] == "complete"
+    assert decode["attrs"]["ttft_s"] > 0
+    # TTFT is reconstructable from the tree: queue start -> prefill end.
+    assert prefill["end"] - by_name["engine:queue"]["start"] > 0
+    # Stage ordering holds on the wall clock.
+    assert by_name["engine:queue"]["start"] <= prefill["start"] \
+        <= decode["start"]
+
+
+@pytest.mark.slow
+def test_serve_request_connected_trace_tree(rt):
+    """Acceptance (slow gate — a fresh llm app deploy + compiles): one
+    sampled serve request produces a SINGLE connected span tree spanning
+    ingress -> handle -> replica -> engine (queue/prefill/decode),
+    reconstructable from the head's span plane by trace id — the
+    X-RT-Trace-Id the HTTP ingress returns.  Engine-stage completeness is
+    ALSO gated by bench_serve --smoke (assert_trace_completeness), so
+    tier-1 keeps the cheap propagation tests while this covers the full
+    serve path."""
+    from ray_tpu.core.context import ctx
+    from ray_tpu.util import trace_analysis
+
+    handle = serve.run(serve.llm_app(
+        engine=dict(GEOMETRY, max_queue=8), name="llmtr"))
+    del handle  # requests go through the HTTP ingress below
+    port = serve.start_http()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/llmtr",
+            data=json.dumps({"prompt_tokens": [5, 7, 11],
+                             "max_new_tokens": 3}).encode(),
+            headers={"Accept": "text/event-stream",
+                     "X-RT-Force-Trace": "1"})
+        with urllib.request.urlopen(req, timeout=180) as resp:
+            trace_id = resp.headers.get("X-RT-Trace-Id")
+            resp.read()
+        assert trace_id, "ingress did not return X-RT-Trace-Id"
+
+        want = {"ingress:llmtr", "handle:llmtr", "replica:llmtr",
+                "task:ServeReplica.handle_request_streaming",
+                "engine:queue", "engine:prefill", "engine:decode"}
+        deadline = time.time() + 30
+        spans = []
+        while time.time() < deadline:
+            spans = ctx.client.call(
+                "list_state",
+                {"kind": "traces", "trace_id": trace_id})["items"]
+            if want <= {s["name"] for s in spans}:
+                break
+            time.sleep(0.3)
+        names = {s["name"] for s in spans}
+        assert want <= names, sorted(names)
+        # SINGLE connected tree: exactly one root, the ingress span.
+        ids = {s["span_id"] for s in spans}
+        roots = [s for s in spans if s.get("parent_id") not in ids]
+        assert [s["name"] for s in roots] == ["ingress:llmtr"], roots
+        # The critical path reaches the engine's decode stage and the
+        # stage breakdown attributes prefill + decode time.
+        path = trace_analysis.critical_path(spans)
+        assert path[0]["name"] == "ingress:llmtr"
+        assert any(r["name"] == "engine:decode" for r in path)
+        stages = trace_analysis.stage_breakdown(spans)
+        assert stages.get("prefill", 0) > 0
+        assert stages.get("decode", 0) > 0
+    finally:
+        serve.stop_http()
+
+
 def test_llm_app_streams_and_cancels_through_serve(rt):
     """The engine behind the full serve stack: handle streaming, SSE
     ingress, and a mid-stream handle cancel that frees the replica's

@@ -61,7 +61,8 @@ def test_task_spans_link_to_driver_span(rt_shared):
         events = ctx.client.call("list_state", {"kind": "timeline"})["items"]
         spans = [e for e in events if e.get("kind") == "span"
                  and e.get("trace_id") == root["trace_id"]]
-        if len(spans) >= 3:  # driver_section + task:work + inside
+        if {"driver_section", "task:work", "inside"} <= \
+                {s["name"] for s in spans}:
             break
         time.sleep(0.2)
     names = {s["name"] for s in spans}
@@ -135,3 +136,380 @@ def test_chrome_trace_skips_malformed_spans():
          "start": None, "end": None},
     ])
     assert [e["name"] for e in out] == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Span plane v2: PRNG ids, batched flush, sampling, drop accounting.
+# ---------------------------------------------------------------------------
+
+
+def test_new_id_is_prng_backed_not_urandom(monkeypatch):
+    """new_id must not pay an os.urandom syscall per call (the PRNG from
+    core/ids is seeded once): after priming, a poisoned urandom changes
+    nothing and ids stay unique."""
+    import os
+
+    tracing.new_id()  # prime the PRNG seed
+
+    def boom(n):  # pragma: no cover — called means regression
+        raise AssertionError("new_id hit os.urandom on the hot path")
+
+    monkeypatch.setattr(os, "urandom", boom)
+    ids = {tracing.new_id() for _ in range(256)}
+    assert len(ids) == 256
+    assert all(len(i) == 16 for i in ids)
+
+
+def test_emit_span_buffers_no_rpc():
+    """emit_span lands in the process-local ring — no client, no RPC, no
+    exception (the old per-span head RPC is gone)."""
+    tracing.drain_buffered()
+    tracing.emit_span({"trace_id": "t", "span_id": "s", "name": "n",
+                       "start": 1.0, "end": 2.0})
+    spans = tracing.drain_buffered()
+    assert [s["name"] for s in spans] == ["n"]
+
+
+def test_span_ring_overflow_drops_counted_and_warned(monkeypatch, caplog):
+    """Ring overflow drops the span, bumps ray_tpu_spans_dropped_total,
+    and logs one WARNING per process — drops are visible, never silent."""
+    import logging
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.util.metrics import get_counter
+
+    tracing.drain_buffered()
+    monkeypatch.setattr(get_config(), "span_ring_size", 16)
+    monkeypatch.setattr(tracing, "_warned_drop", False)
+    counter = get_counter("ray_tpu_spans_dropped_total")
+    before = sum(counter._values.values())
+    with caplog.at_level(logging.WARNING, logger="ray_tpu.tracing"):
+        for i in range(40):
+            tracing.emit_span({"trace_id": "t", "span_id": str(i),
+                               "name": "n", "start": 0.0, "end": 1.0})
+    kept = tracing.drain_buffered()
+    assert len(kept) == 16
+    assert sum(counter._values.values()) - before == 24
+    warnings = [r for r in caplog.records
+                if "ray_tpu_spans_dropped_total" in r.getMessage()]
+    assert len(warnings) == 1  # once per process, not per span
+
+
+def test_spans_buffer_headless_and_replay():
+    """Spans emitted while the head connection is down stay in the
+    BOUNDED ring (a long outage must not grow the client's held submit
+    batch without limit — ring overflow drops are counted instead), and
+    the first post-reconnect flush replays them as one span_batch entry;
+    a span_batch entry staged BEFORE the outage rides the held submit
+    batch like task_done reports (PR 9)."""
+    import threading
+    from collections import deque
+
+    from ray_tpu.core import client as client_mod
+
+    class DeadRpc:
+        closed = True
+
+        def call_async(self, *a, **k):  # pragma: no cover
+            raise AssertionError("headless flush fired into a dead socket")
+
+    c = client_mod.Client.__new__(client_mod.Client)
+    c.rpc = DeadRpc()
+    c._bg_exc = None
+    c._bg_futs = deque()
+    c._bg_lock = threading.Lock()
+    c._put_batch = []
+    c._put_batch_lock = threading.Lock()
+    # An entry that was already staged when the connection dropped: must
+    # hold (not drop) while headless.
+    c._submit_batch = [{"method": "span_batch",
+                        "body": {"spans": [{"trace_id": "t",
+                                            "span_id": "pre",
+                                            "name": "staged-pre-outage",
+                                            "start": 0.5, "end": 0.9}]}}]
+    c._submit_batch_lock = threading.Lock()
+
+    tracing.drain_buffered()
+    tracing.emit_span({"trace_id": "t", "span_id": "a", "name": "held",
+                       "start": 1.0, "end": 2.0})
+    # Headless flush is a NO-OP: the span stays in the bounded ring, the
+    # submit batch does not grow for the outage's duration.
+    assert tracing.flush_spans(c) == 0
+    assert len(c._submit_batch) == 1
+    c._flush_submit_batch()  # still headless: staged entry must not drop
+    assert len(c._submit_batch) == 1
+
+    sent = []
+
+    class LiveRpc:
+        closed = False
+
+        def call_async(self, method, body):
+            sent.append((method, body))
+
+            class F:
+                def done(self):
+                    return True
+
+                def exception(self):
+                    return None
+
+            return F()
+
+    c.rpc = LiveRpc()
+    assert tracing.flush_spans(c) == 1  # reconnect: ring drains
+    c._flush_submit_batch()
+    assert len(sent) == 1 and sent[0][0] == "batch"
+    entries = sent[0][1]["entries"]
+    names = [s["name"] for e in entries for s in e["body"]["spans"]]
+    assert set(names) == {"staged-pre-outage", "held"}
+
+
+def test_unsampled_root_propagates_and_emits_nothing(rt_shared):
+    """With the head-configured rate at 0, a trace root is unsampled:
+    no spans buffer, context_for_submit is None (zero propagation), and
+    nesting still behaves.  force=True overrides per call."""
+    from ray_tpu.core.context import ctx
+
+    old = getattr(ctx.client, "trace_sample_rate", None)
+    ctx.client.trace_sample_rate = 0.0
+    try:
+        tracing.drain_buffered()
+        with tracing.trace("invisible") as t:
+            assert t.get("sampled") is False
+            assert tracing.context_for_submit() is None
+            with tracing.trace("nested-invisible"):
+                assert tracing.context_for_submit() is None
+        assert tracing.drain_buffered() == []
+        assert tracing.current_context() is None
+        # Per-call override: force=True roots a sampled trace anyway.
+        with tracing.trace("forced", force=True) as t2:
+            assert tracing.context_for_submit() is not None
+            assert t2["trace_id"]
+        assert [s["name"] for s in tracing.drain_buffered()] == ["forced"]
+    finally:
+        ctx.client.trace_sample_rate = old
+
+
+def test_register_reply_carries_head_sample_rate(rt_shared):
+    """The head hands its trace_sample_rate to every registering process:
+    one knob on the head governs the cluster."""
+    from ray_tpu.core.context import ctx
+
+    assert ctx.client.trace_sample_rate == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Propagation: direct (peer-to-peer) actor calls + leased task dispatch.
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ctx_propagates_across_direct_actor_calls(rt_shared):
+    """Actor calls ride the peer plane (no per-call head dispatch), yet
+    their execution spans still land in the timeline, linked to the
+    driver's root span — span traffic is batched telemetry, not RPC."""
+    from ray_tpu.core.context import ctx
+    from ray_tpu.util.metrics import get_counter
+
+    @ray_tpu.remote
+    class Bumper:
+        def bump(self, x):
+            return x + 1
+
+    b = Bumper.remote()
+    # Wait until THIS actor's peer route is live (the order-safe switch
+    # defers the direct plane while head-routed calls may be in flight;
+    # the global counter is useless here — earlier tests in the shared
+    # cluster already bumped it).
+    dp = ctx.client._dataplane
+    raw = b._actor_id.binary()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        assert ray_tpu.get(b.bump.remote(0)) == 1
+        with dp._lock:
+            route = dp._routes.get(raw)
+            ready = (route is not None and route.slot is not None
+                     and not route.slot.dead)
+        if ready:
+            break
+        time.sleep(0.05)
+    assert ready, "actor route never switched to the peer plane"
+    direct = get_counter("ray_tpu_direct_calls_total")
+    base_direct = sum(direct._values.values())
+    n_calls = 12
+    with tracing.trace("actor_root") as root:
+        refs = [b.bump.remote(i) for i in range(n_calls)]
+        assert sorted(ray_tpu.get(refs)) == list(range(1, n_calls + 1))
+
+    deadline = time.monotonic() + 15
+    spans = []
+    while time.monotonic() < deadline:
+        events = ctx.client.call(
+            "list_state", {"kind": "traces",
+                           "trace_id": root["trace_id"]})["items"]
+        spans = [e for e in events if e["name"] == "task:Bumper.bump"]
+        if len(spans) >= n_calls:
+            break
+        time.sleep(0.2)
+    assert len(spans) >= n_calls, len(spans)
+    assert all(s["parent_id"] == root["span_id"] for s in spans)
+    # The traced burst really was peer-routed (driver-side counter lives
+    # in this process) — propagation held on the direct plane.
+    assert sum(direct._values.values()) >= base_direct + n_calls
+
+
+def test_trace_ctx_propagates_across_leased_tasks(rt_shared):
+    """Stateless tasks dispatched through node-local leases (no head
+    routing) still carry trace_ctx and report execution spans."""
+    from ray_tpu.core.context import ctx
+
+    @ray_tpu.remote
+    def leaf(x):
+        return x * 2
+
+    # Prime lease pools so the traced burst below rides the lease plane.
+    assert sorted(ray_tpu.get([leaf.remote(i) for i in range(8)])) == \
+        [0, 2, 4, 6, 8, 10, 12, 14]
+    with tracing.trace("lease_root") as root:
+        assert sorted(ray_tpu.get([leaf.remote(i) for i in range(6)])) == \
+            [0, 2, 4, 6, 8, 10]
+    deadline = time.monotonic() + 15
+    spans = []
+    while time.monotonic() < deadline:
+        events = ctx.client.call(
+            "list_state", {"kind": "traces",
+                           "trace_id": root["trace_id"]})["items"]
+        spans = [e for e in events if e["name"] == "task:leaf"]
+        if len(spans) >= 6:
+            break
+        time.sleep(0.2)
+    assert len(spans) >= 6, len(spans)
+    assert all(s["parent_id"] == root["span_id"] for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# Trace analysis: tree, critical path, stages, waterfall, CLI.
+# ---------------------------------------------------------------------------
+
+
+def _seed_trace(t0=1000.0):
+    """A known three-stage tree: root[0,1] -> submit(flow) + task[.4,.95]
+    with nested engine stages."""
+    tid = tracing.new_id()
+    root_id, task_id, sub_id = (tracing.new_id() for _ in range(3))
+    pre_id, dec_id = tracing.new_id(), tracing.new_id()
+    spans = [
+        {"kind": "span", "trace_id": tid, "span_id": root_id,
+         "parent_id": None, "name": "ingress:app", "start": t0,
+         "end": t0 + 1.0, "pid": 1},
+        {"kind": "span", "trace_id": tid, "span_id": sub_id,
+         "parent_id": root_id, "name": "submit:work", "start": t0 + 0.01,
+         "end": t0 + 0.01, "pid": 1, "attrs": {"flow_id": task_id}},
+        {"kind": "span", "trace_id": tid, "span_id": task_id,
+         "parent_id": root_id, "name": "task:work", "start": t0 + 0.40,
+         "end": t0 + 0.95, "pid": 2},
+        {"kind": "span", "trace_id": tid, "span_id": pre_id,
+         "parent_id": task_id, "name": "engine:prefill",
+         "start": t0 + 0.45, "end": t0 + 0.60, "pid": 2,
+         "attrs": {"bucket": 16}},
+        {"kind": "span", "trace_id": tid, "span_id": dec_id,
+         "parent_id": task_id, "name": "engine:decode",
+         "start": t0 + 0.60, "end": t0 + 0.94, "pid": 2,
+         "attrs": {"tokens": 4}},
+    ]
+    return tid, spans
+
+
+def test_trace_analysis_critical_path_and_stages():
+    from ray_tpu.util import trace_analysis as ta
+
+    _, spans = _seed_trace()
+    path = [r["name"] for r in ta.critical_path(spans)]
+    # The backward sibling walk keeps prefill (it gates decode) on the
+    # path, and the submission point bounds the earliest segment.
+    assert path == ["ingress:app", "submit:work", "task:work",
+                    "engine:prefill", "engine:decode"]
+    rows = {r["name"]: r for r in ta.critical_path(spans)}
+    # Self time is interval coverage: the root's self excludes the whole
+    # task subtree, the task's self excludes its engine children.
+    assert abs(rows["ingress:app"]["self_s"] - 0.45) < 1e-6
+    assert abs(rows["task:work"]["self_s"] - 0.06) < 1e-6
+    stages = ta.stage_breakdown(spans)
+    assert abs(stages["prefill"] - 0.15) < 1e-6
+    assert abs(stages["decode"] - 0.34) < 1e-6
+    # Flow gap submit->task start becomes the schedule stage, MOVED out
+    # of the enclosing span's self time (no double count)...
+    assert abs(stages["schedule"] - 0.39) < 1e-6
+    # ...so ingress keeps only its genuine self time (grandchildren that
+    # outlive the direct child are also discounted)...
+    assert abs(stages["ingress"] - 0.06) < 1e-6
+    # ...and the stage totals account for exactly the trace's wall time.
+    assert abs(sum(stages.values()) - 1.0) < 1e-6
+    text = ta.format_trace(spans)
+    assert "critical path:" in text and "stage breakdown:" in text
+    assert "engine:decode" in text
+    summary = ta.summarize(spans)
+    assert summary[0]["root"] == "ingress:app"
+    assert summary[0]["spans"] == 5
+
+
+def test_trace_cli_waterfall_and_chrome(rt_shared, capsys):
+    """`ray_tpu trace` end to end against a seeded trace: listing,
+    waterfall + critical path + stages, and per-trace --chrome export
+    with flow arrows."""
+    import json as _json
+
+    from ray_tpu import scripts
+    from ray_tpu.core.context import ctx
+
+    tid, spans = _seed_trace(t0=time.time())
+    ctx.client.call("span_batch", {"spans": spans})
+
+    assert scripts.main(["trace"]) == 0
+    out = capsys.readouterr().out
+    assert tid[:16] in out and "ingress:app" in out
+
+    assert scripts.main(["trace", tid[:12]]) == 0
+    out = capsys.readouterr().out
+    assert "critical path:" in out
+    assert "stage breakdown:" in out
+    assert "engine:prefill" in out and "schedule" in out
+
+    assert scripts.main(["trace", tid, "--chrome"]) == 0
+    events = _json.loads(capsys.readouterr().out)
+    assert sum(1 for e in events if e["ph"] == "X") == 5
+    assert sum(1 for e in events if e["ph"] in ("s", "f")) == 2
+
+    assert scripts.main(["trace", "feedfacedeadbeef"]) == 1
+
+
+def test_list_state_traces_summary_and_filter(rt_shared):
+    from ray_tpu.core.context import ctx
+
+    tid, spans = _seed_trace(t0=time.time())
+    ctx.client.call("span_batch", {"spans": spans})
+    rows = ctx.client.call("list_state", {"kind": "traces"})["items"]
+    mine = [r for r in rows if r["trace_id"] == tid]
+    assert mine and mine[0]["spans"] == 5
+    got = ctx.client.call(
+        "list_state", {"kind": "traces", "trace_id": tid})["items"]
+    assert len(got) == 5
+    assert {s["name"] for s in got} == {
+        "ingress:app", "submit:work", "task:work", "engine:prefill",
+        "engine:decode"}
+
+    # Ambiguous prefix: two traces sharing a prefix must NOT merge into
+    # one bogus span list — the reply serves the most recent match and
+    # names the rest.
+    now = time.time()
+    for i, suffix in enumerate(("1111", "2222")):
+        ctx.client.call("span_batch", {"spans": [{
+            "trace_id": f"ambigfeed{suffix}", "span_id": f"s{suffix}",
+            "parent_id": None, "name": f"root{suffix}",
+            "start": now + i, "end": now + i + 0.5, "pid": 1,
+        }]})
+    reply = ctx.client.call(
+        "list_state", {"kind": "traces", "trace_id": "ambigfeed"})
+    assert sorted(reply["ambiguous_matches"]) == [
+        "ambigfeed1111", "ambigfeed2222"]
+    assert {s["trace_id"] for s in reply["items"]} == {"ambigfeed2222"}
