@@ -38,8 +38,16 @@ OUTPUT_MIX = (4, 8, 16, 128)
 ENGINE_KW = dict(batch_slots=8, page_size=16, max_prompt_len=16,
                  max_new_tokens_cap=128, max_queue=16)
 
+# Shared-prefix geometry (G2): prompts must span MULTIPLE pages for the
+# radix cache to have anything page-aligned to reuse, so this row trades
+# page size down and prompt length up.  It runs LAST — a second decode
+# geometry means a second compiled program, and the G1 rows' single-
+# compile assertions must not see it.
+PREFIX_KW = dict(batch_slots=8, page_size=8, max_prompt_len=48,
+                 max_new_tokens_cap=32, max_queue=16)
 
-def _build_engine(mode: str, seed: int = 0):
+
+def _build_engine(mode: str, seed: int = 0, engine_kw: Optional[Dict] = None):
     import jax
     import jax.numpy as jnp
 
@@ -53,8 +61,9 @@ def _build_engine(mode: str, seed: int = 0):
                       n_heads=8, n_kv_heads=4, d_ff=1152, max_seq=256,
                       remat=False, dtype=jnp.float32)
     params = llama_init(cfg, jax.random.PRNGKey(seed))
-    eng = InferenceEngine(cfg, params,
-                          EngineConfig(mode=mode, **ENGINE_KW), seed=seed)
+    eng = InferenceEngine(
+        cfg, params,
+        EngineConfig(mode=mode, **(engine_kw or ENGINE_KW)), seed=seed)
     eng.warmup()
     return eng
 
@@ -245,6 +254,218 @@ def assert_trace_completeness(engine) -> Dict:
     return {"stages": sorted(by_name), "ttft_s": round(ttft_s, 6)}
 
 
+def run_adapter_mix(n_requests: int, seed: int = 0) -> Dict:
+    """Multi-LoRA traffic: requests rotate across the base model and six
+    registered adapters (more adapters than device slots, so the pool
+    must evict under load) in waves that decode TOGETHER in one batch.
+    The row's contract: the adapter mix is per-slot DATA — the single
+    compiled decode program from the earlier rows serves every mix, or
+    this raises SystemExit."""
+    from ray_tpu.serve.engine import random_lora
+
+    eng = _build_engine("continuous", seed=seed)
+    try:
+        cfg, rank = eng.model_config, eng.config.lora_rank
+        names = [f"lora{i}" for i in range(6)]
+        for i, name in enumerate(names):
+            eng.register_adapter(
+                name, lambda s=i + 1: random_lora(cfg, s, rank=rank))
+        choices = [None] + names
+        rng = np.random.default_rng(seed)
+        tokens = 0
+        t0 = time.perf_counter()
+        wave = eng.config.batch_slots
+        for base in range(0, n_requests, wave):
+            streams = []
+            for i in range(base, min(base + wave, n_requests)):
+                prompt = rng.integers(
+                    1, 400, size=int(PROMPT_MIX[i % len(PROMPT_MIX)]))
+                streams.append(eng.submit(
+                    prompt,
+                    max_new_tokens=int(OUTPUT_MIX[i % len(OUTPUT_MIX)]),
+                    adapter=choices[i % len(choices)]))
+            for s in streams:
+                tokens += sum(1 for _ in s)
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        if st["decode_traces"] != 1:
+            raise SystemExit(
+                f"adapter-mix row retraced the decode program "
+                f"({st['decode_traces']} traces) — adapter ids must stay "
+                "per-slot data")
+        eng.clear_prefix_cache()
+        return {
+            "requests": n_requests,
+            "adapters": len(names),
+            "adapter_slots": eng.config.max_adapters,
+            "tokens_per_s": round(tokens / wall, 1),
+            "wall_s": round(wall, 3),
+            "adapter_loads": st["adapters"]["loads"],
+            "adapter_evictions": st["adapters"]["evictions"],
+            "decode_traces": st["decode_traces"],
+            "free_list_balanced": (
+                eng.allocator.free_count == eng.allocator.total),
+        }
+    finally:
+        eng.shutdown()
+
+
+def run_tenant_overload(cap_rps: float, n_requests: int,
+                        seed: int = 0) -> List[Dict]:
+    """Two tenants (gold weight 4, free weight 1) offer EQUAL open-loop
+    traffic at 1x and 2x capacity.  Overload must degrade PER TENANT:
+    weighted-fair admission sheds the free tier's queue tail while gold's
+    latency holds — a global FIFO would punish both equally.  Raises
+    SystemExit when the shed distribution inverts at 2x."""
+    from ray_tpu.serve.engine import EngineOverloadedError
+
+    tenants = (("gold", 4.0), ("free", 1.0))
+    rows = []
+    for lvl in (1.0, 2.0):
+        eng = _build_engine("continuous", seed=seed)
+        try:
+            rng = np.random.default_rng(seed)
+            rate = cap_rps * lvl
+            gaps = rng.exponential(1.0 / rate, size=n_requests)
+            prompts = rng.choice(PROMPT_MIX, size=n_requests)
+            outs = rng.choice(OUTPUT_MIX, size=n_requests)
+            streams: Dict[str, list] = {t: [] for t, _ in tenants}
+            shed = {t: 0 for t, _ in tenants}
+            offered = {t: 0 for t, _ in tenants}
+            t0 = time.perf_counter()
+            next_t = t0
+            for i in range(n_requests):
+                next_t += gaps[i]
+                delay = next_t - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                tname, weight = tenants[i % len(tenants)]
+                offered[tname] += 1
+                prompt = rng.integers(1, 400, size=int(prompts[i]))
+                try:
+                    streams[tname].append(eng.submit(
+                        prompt, max_new_tokens=int(outs[i]),
+                        tenant=tname, weight=weight))
+                except EngineOverloadedError:
+                    shed[tname] += 1
+            per_tenant = {}
+            for tname, weight in tenants:
+                done = []
+                for s in streams[tname]:
+                    try:
+                        for _tok in s:
+                            pass
+                    except EngineOverloadedError:
+                        shed[tname] += 1
+                        continue
+                    done.append(s._req)
+                done = [r for r in done if r.first_token_t is not None]
+                ttfts = [r.first_token_t - r.submit_t for r in done]
+                per_tenant[tname] = {
+                    "weight": weight,
+                    "offered": offered[tname],
+                    "completed": len(done),
+                    "shed": shed[tname],
+                    "p50_ttft_s": _pct(ttfts, 50),
+                    "p99_ttft_s": _pct(ttfts, 99),
+                }
+            eng.clear_prefix_cache()
+            rows.append({
+                "load_level": lvl,
+                "offered_rps": round(rate, 3),
+                "tenants": per_tenant,
+                "free_list_balanced": (
+                    eng.allocator.free_count == eng.allocator.total),
+                "decode_traces": eng.stats()["decode_traces"],
+            })
+        finally:
+            eng.shutdown()
+    over = rows[-1]["tenants"]
+    if over["free"]["shed"] < over["gold"]["shed"]:
+        raise SystemExit(
+            "tenant-overload row FAILED: weighted-fair shed fell on the "
+            f"high-weight tenant (gold shed {over['gold']['shed']}, free "
+            f"shed {over['free']['shed']})")
+    return rows
+
+
+def run_shared_prefix(n_requests: int, seed: int = 0) -> Dict:
+    """Fleet-shares-a-system-prompt traffic: every prompt starts with the
+    same 24 tokens (3 full pages under G2) plus a random tail.  The radix
+    cache must serve the prefix from frozen pages — hit rate > 0.5 — and
+    cached decode must be TOKEN-EXACT vs the cold path, or this raises
+    SystemExit.  Runs under its own geometry, so trace assertions are
+    delta-based against the row's own warmup."""
+    from ray_tpu.models.paged import trace_count
+
+    eng = _build_engine("continuous", seed=seed, engine_kw=PREFIX_KW)
+    try:
+        ps = eng.config.page_size
+        rng = np.random.default_rng(seed)
+        prefix = [int(t) for t in rng.integers(1, 400, size=3 * ps)]
+
+        # Token-exact parity: the same prompt cold (no cached pages) and
+        # warm (prefix + COW source cached) must decode identically.
+        eng.clear_prefix_cache()
+        probe = prefix + [int(t) for t in rng.integers(1, 400, size=8)]
+        cold = list(eng.submit(probe, max_new_tokens=8))
+        warm = list(eng.submit(probe, max_new_tokens=8))
+        if warm != cold:
+            raise SystemExit(
+                f"shared-prefix row FAILED: cached decode diverged from "
+                f"cold decode ({warm} != {cold})")
+        eng.clear_prefix_cache()
+
+        # Warm the tree with ONE request before the open fire: admission
+        # looks prefixes up when requests enter slots, so a full first
+        # wave would all miss together (nothing has prefilled yet) and
+        # understate steady-state reuse.
+        list(eng.submit(prefix + [7], max_new_tokens=2))
+
+        hits_0 = eng.stats()["prefix_cache"]["hits"]
+        lookups_0 = eng.stats()["prefix_cache"]["lookups"]
+        decode_traces_0 = trace_count("decode")
+        tokens = 0
+        t0 = time.perf_counter()
+        wave = eng.config.batch_slots
+        for base in range(0, n_requests, wave):
+            streams = []
+            for i in range(base, min(base + wave, n_requests)):
+                tail = [int(t) for t in rng.integers(1, 400, size=8)]
+                streams.append(eng.submit(prefix + tail, max_new_tokens=8))
+            for s in streams:
+                tokens += sum(1 for _ in s)
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        cache = st["prefix_cache"]
+        looked = cache["lookups"] - lookups_0
+        hit_rate = (cache["hits"] - hits_0) / max(1, looked)
+        if hit_rate <= 0.5:
+            raise SystemExit(
+                f"shared-prefix row FAILED: cache hit rate {hit_rate:.2f} "
+                "<= 0.5 on shared-prefix traffic")
+        if trace_count("decode") != decode_traces_0:
+            raise SystemExit(
+                "shared-prefix row retraced the decode program mid-traffic")
+        shared_peak = st["shared_pages"]
+        eng.clear_prefix_cache()
+        return {
+            "requests": n_requests,
+            "prefix_tokens": len(prefix),
+            "engine": PREFIX_KW,
+            "tokens_per_s": round(tokens / wall, 1),
+            "wall_s": round(wall, 3),
+            "cache_hit_rate": round(hit_rate, 3),
+            "prefix_traces": st["prefill_prefix_traces"],
+            "pages_shared_end": shared_peak,
+            "parity": "token_exact",
+            "free_list_balanced": (
+                eng.allocator.free_count == eng.allocator.total),
+        }
+    finally:
+        eng.shutdown()
+
+
 def main(argv=None) -> Dict:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -303,6 +524,18 @@ def main(argv=None) -> Dict:
             rows.append(row)
         report["modes"][mode] = rows
 
+    # Multi-tenant serving plane rows: batched-LoRA mixes and weighted-
+    # fair tenants reuse the G1 geometry (single-compile assertions hold
+    # across them); the shared-prefix row runs LAST under G2.
+    n_mix = 16 if args.smoke else 48
+    n_ten = 16 if args.smoke else 48
+    n_pfx = 12 if args.smoke else 32
+    report["multi_tenant"] = {
+        "adapter_mix": run_adapter_mix(n_mix),
+        "tenant_overload": run_tenant_overload(cap_rps, n_ten),
+        "shared_prefix": run_shared_prefix(n_pfx),
+    }
+
     def _at(mode, lvl):
         return next(r for r in report["modes"][mode]
                     if r["load_level"] == lvl)
@@ -322,6 +555,20 @@ def main(argv=None) -> Dict:
         "overload_goodput_ratio": round(
             c_over["tokens_per_s"] / max(c_sat["tokens_per_s"], 1e-9), 2),
         "overload_shed": c_over["shed"],
+        "adapter_mix_tokens_per_s":
+            report["multi_tenant"]["adapter_mix"]["tokens_per_s"],
+        "prefix_cache_hit_rate":
+            report["multi_tenant"]["shared_prefix"]["cache_hit_rate"],
+        "tenant_2x_p99_ttft_s": {
+            t: rec["p99_ttft_s"]
+            for t, rec in report["multi_tenant"]["tenant_overload"][-1]
+            ["tenants"].items()
+        },
+        "tenant_2x_shed": {
+            t: rec["shed"]
+            for t, rec in report["multi_tenant"]["tenant_overload"][-1]
+            ["tenants"].items()
+        },
     }
 
     if not args.smoke:

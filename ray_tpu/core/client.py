@@ -901,10 +901,23 @@ class Client:
                     break
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    break
                 head_pending = [raw for raw in head_raws
                                 if raw not in head_ready]
+                if remaining is not None and remaining <= 0:
+                    # Budget exhausted — including the pure-poll timeout=0
+                    # case, which must still ask the head once: breaking
+                    # without a poll reports already-sealed head objects
+                    # as not-ready forever.
+                    if head_pending:
+                        head_ready |= self._wait_head(
+                            head_pending,
+                            min(max(num_returns - len(ready_set), 1),
+                                len(head_pending)),
+                            0.0,
+                        )
+                        local_ready, _, _ = dp.wait_split(raws)
+                        ready_set = local_ready | head_ready
+                    break
                 if head_pending:
                     slice_t = 0.05 if events else remaining
                     if remaining is not None and slice_t is not None:

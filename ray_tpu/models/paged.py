@@ -61,19 +61,89 @@ def init_paged_pools(config: LlamaConfig, num_pages: int,
             "v": jnp.zeros(shape, config.dtype)}
 
 
+# ------------------------------------------------------- adapter pool
+
+#: {"qa": [A+1, L, d, r], "qb": [A+1, L, r, d], "va": [A+1, L, d, r],
+#:  "vb": [A+1, L, r, kv_out], "scale": [A+1]} — slot A is the permanent
+#: zero adapter (scale 0), so base-model slots are just data too.
+AdapterArrays = Dict[str, jax.Array]
+
+
+def init_adapter_pool(config: LlamaConfig, max_adapters: int,
+                      rank: int) -> AdapterArrays:
+    """Device-resident pool of ``max_adapters`` LoRA slots plus one zero
+    slot at index ``max_adapters``.  The pool's SHAPES are part of every
+    decode/prefill signature, so loading, evicting, or remixing adapters
+    never recompiles — only the per-slot ``adapter_ids`` data changes."""
+    d = config.d_model
+    kv_out = config.n_kv_heads * config.head_dim
+    A, L = max_adapters + 1, config.n_layers
+    return {
+        "qa": jnp.zeros((A, L, d, rank), config.dtype),
+        "qb": jnp.zeros((A, L, rank, d), config.dtype),
+        "va": jnp.zeros((A, L, d, rank), config.dtype),
+        "vb": jnp.zeros((A, L, rank, kv_out), config.dtype),
+        "scale": jnp.zeros((A,), jnp.float32),
+    }
+
+
+def pack_lora(config: LlamaConfig, lora: Params) -> AdapterArrays:
+    """Stack a ``lora_init``-style adapter (list of per-layer dicts) into
+    the dense per-slot layout ``adapter_load`` writes into the pool."""
+    ls = lora["layers"]
+    return {
+        "qa": jnp.stack([l["wq_lora_a"] for l in ls]).astype(config.dtype),
+        "qb": jnp.stack([l["wq_lora_b"] for l in ls]).astype(config.dtype),
+        "va": jnp.stack([l["wv_lora_a"] for l in ls]).astype(config.dtype),
+        "vb": jnp.stack([l["wv_lora_b"] for l in ls]).astype(config.dtype),
+        "scale": jnp.asarray(ls[0]["scale"], jnp.float32),
+    }
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def adapter_load(adapters: AdapterArrays, slot: jax.Array,
+                 packed: AdapterArrays) -> AdapterArrays:
+    """Overwrite one pool slot in place (slot index is data; pool arrays
+    are donated so load/evict churn never copies the resident set)."""
+    _bump("adapter_load")
+    return {name: adapters[name].at[slot].set(packed[name])
+            for name in ("qa", "qb", "va", "vb", "scale")}
+
+
+def _lora_delta_batched(h: jax.Array, a: jax.Array, b: jax.Array,
+                        scale: jax.Array) -> jax.Array:
+    """Per-slot low-rank delta: h [B, d], a [B, d, r], b [B, r, out],
+    scale [B] -> [B, out].  Rank is tiny, so this is two skinny matmuls
+    per projection — the price of serving any adapter mix in one
+    program."""
+    t = jnp.einsum("bd,bdr->br", h, a)
+    return (jnp.einsum("br,bro->bo", t, b)
+            * scale[:, None].astype(h.dtype))
+
+
+def _lora_delta_seq(h: jax.Array, a: jax.Array, b: jax.Array,
+                    scale: jax.Array) -> jax.Array:
+    """One adapter over a sequence: h [S, d], a [d, r], b [r, out]."""
+    return ((h @ a) @ b) * scale.astype(h.dtype)
+
+
 class PageAllocator:
-    """Free-list page allocator (host side; the engine serializes access).
+    """Refcounted free-list page allocator (host side; the engine
+    serializes access).
 
     All-or-nothing ``alloc``: a sequence is admitted only when its whole
     worst-case footprint fits, so decode can never die of page exhaustion
     mid-flight — admission control happens at the boundary, not inside
-    the loop.  Double frees fail loudly (a page on two sequences corrupts
-    both)."""
+    the loop.  ``share`` grows a page's refcount (prefix-cache reuse: the
+    radix tree and every sequence reading a cached page each hold a ref);
+    ``free`` releases one ref and only returns the page to the free list
+    at zero.  Releasing a page nobody holds fails loudly (a page on two
+    sequences corrupts both)."""
 
     def __init__(self, num_pages: int):
         self.total = num_pages
         self._free: List[int] = list(range(num_pages))
-        self._allocated: set = set()
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_count(self) -> int:
@@ -83,21 +153,44 @@ class PageAllocator:
     def used_count(self) -> int:
         return self.total - len(self._free)
 
+    @property
+    def shared_count(self) -> int:
+        """Pages currently held by more than one owner."""
+        return sum(1 for n in self._refs.values() if n > 1)
+
+    def refs(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n pages, or None when the pool can't cover them (caller queues
-        or sheds — never partial)."""
+        """n pages at refcount 1, or None when the pool can't cover them
+        (caller queues or sheds — never partial)."""
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
-    def free(self, pages: List[int]) -> None:
+    def share(self, pages: List[int]) -> None:
+        """One more owner per page (must be live — sharing a freed page
+        would resurrect a slot the free list already handed out)."""
         for p in pages:
-            if p not in self._allocated:
+            if p not in self._refs:
+                raise AssertionError(f"share of unallocated KV page {p}")
+            self._refs[p] += 1
+
+    def free(self, pages: List[int]) -> None:
+        """Release one ref per page; the page returns to the free list
+        only when its last owner lets go."""
+        for p in pages:
+            n = self._refs.get(p)
+            if n is None:
                 raise AssertionError(f"double free of KV page {p}")
-            self._allocated.discard(p)
-            self._free.append(p)
+            if n == 1:
+                del self._refs[p]
+                self._free.append(p)
+            else:
+                self._refs[p] = n - 1
 
 
 def _rotary_single(x: jax.Array, cos: jax.Array, sin: jax.Array,
@@ -125,9 +218,10 @@ def _sample_tokens(logits: jax.Array, temps: jax.Array,
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def paged_decode_step(config: LlamaConfig, params: Params,
-                      pools: PagedPools, tokens: jax.Array,
-                      page_tables: jax.Array, seq_lens: jax.Array,
-                      active: jax.Array, temps: jax.Array,
+                      pools: PagedPools, adapters: AdapterArrays,
+                      tokens: jax.Array, page_tables: jax.Array,
+                      seq_lens: jax.Array, active: jax.Array,
+                      temps: jax.Array, adapter_ids: jax.Array,
                       key: jax.Array):
     """One decode step for every batch slot at once.
 
@@ -135,10 +229,12 @@ def paged_decode_step(config: LlamaConfig, params: Params,
     int32 (scratch index past each sequence's allocated prefix), seq_lens
     [B] int32 = tokens already cached (the new token is WRITTEN at
     position seq_lens and attends positions <= seq_lens), active [B]
-    bool, temps [B] float32.  Inactive slots pass seq_lens=0 and an
-    all-scratch page table: their writes land on the scratch page and
-    their sampled token is ignored host-side.  Pools are donated —
-    steady-state decode never copies the cache.
+    bool, temps [B] float32, adapter_ids [B] int32 pool-slot indices
+    (the zero slot for base-model requests — per-slot adapters are DATA,
+    so one compiled program serves any adapter mix).  Inactive slots
+    pass seq_lens=0 and an all-scratch page table: their writes land on
+    the scratch page and their sampled token is ignored host-side.
+    Pools are donated — steady-state decode never copies the cache.
 
     The PRNG key and the slot lengths advance ON DEVICE (returned
     alongside the tokens), so the serving loop's only per-step host
@@ -158,12 +254,20 @@ def paged_decode_step(config: LlamaConfig, params: Params,
     page_idx = page_tables[b_idx, seq_lens // ps]  # [B]
     off = seq_lens % ps
     pos_grid = jnp.arange(maxp * ps)[None, None, :]  # [1, 1, MAXP*ps]
+    # One gather per adapter array for the whole step: [B, L, ...].
+    qa_g, qb_g = adapters["qa"][adapter_ids], adapters["qb"][adapter_ids]
+    va_g, vb_g = adapters["va"][adapter_ids], adapters["vb"][adapter_ids]
+    lscale = adapters["scale"][adapter_ids]  # [B]
     for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         a = layer["attn"]
-        q = (h @ a["wq"]).reshape(B, config.n_heads, config.head_dim)
+        q_flat = h @ a["wq"] + _lora_delta_batched(
+            h, qa_g[:, i], qb_g[:, i], lscale)
+        v_flat = h @ a["wv"] + _lora_delta_batched(
+            h, va_g[:, i], vb_g[:, i], lscale)
+        q = q_flat.reshape(B, config.n_heads, config.head_dim)
         k = (h @ a["wk"]).reshape(B, config.n_kv_heads, config.head_dim)
-        v = (h @ a["wv"]).reshape(B, config.n_kv_heads, config.head_dim)
+        v = v_flat.reshape(B, config.n_kv_heads, config.head_dim)
         q = _rotary_single(q, cos, sin, seq_lens)
         k = _rotary_single(k, cos, sin, seq_lens)
         k_pool = k_pool.at[i, page_idx, :, off, :].set(
@@ -199,14 +303,16 @@ def paged_decode_step(config: LlamaConfig, params: Params,
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def paged_prefill(config: LlamaConfig, params: Params, pools: PagedPools,
-                  tokens: jax.Array, length: jax.Array,
-                  page_table: jax.Array, temp: jax.Array, key: jax.Array):
+                  adapters: AdapterArrays, tokens: jax.Array,
+                  length: jax.Array, page_table: jax.Array,
+                  adapter_id: jax.Array, temp: jax.Array, key: jax.Array):
     """Prefill ONE sequence's prompt into its pages and sample the first
     token.
 
     tokens [1, S_pad] int32 (prompt padded to a bucket length — one
     compile per bucket, see the engine's bucket table), length scalar =
-    real prompt length, page_table [MAXP].  Padded tail positions write
+    real prompt length, page_table [MAXP], adapter_id scalar pool-slot
+    index (data, like the decode step's).  Padded tail positions write
     through the page table like real ones (their garbage K/V is masked by
     length until decode overwrites it) or to the scratch page past the
     allocated prefix.  The key advances on device like the decode step's.
@@ -224,15 +330,20 @@ def paged_prefill(config: LlamaConfig, params: Params, pools: PagedPools,
     row = positions[:, None]
     col = positions[None, :]
     causal = col <= row  # [S_pad, S_pad]
+    qa_g, qb_g = adapters["qa"][adapter_id], adapters["qb"][adapter_id]
+    va_g, vb_g = adapters["va"][adapter_id], adapters["vb"][adapter_id]
+    lscale = adapters["scale"][adapter_id]
     for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         a = layer["attn"]
-        q = (h @ a["wq"]).reshape(s_pad, config.n_heads, config.head_dim
-                                  ).transpose(1, 0, 2)  # [H, S, D]
+        q = (h @ a["wq"] + _lora_delta_seq(h, qa_g[i], qb_g[i], lscale)
+             ).reshape(s_pad, config.n_heads, config.head_dim
+                       ).transpose(1, 0, 2)  # [H, S, D]
         k = (h @ a["wk"]).reshape(s_pad, config.n_kv_heads, config.head_dim
                                   ).transpose(1, 0, 2)
-        v = (h @ a["wv"]).reshape(s_pad, config.n_kv_heads, config.head_dim
-                                  ).transpose(1, 0, 2)
+        v = (h @ a["wv"] + _lora_delta_seq(h, va_g[i], vb_g[i], lscale)
+             ).reshape(s_pad, config.n_kv_heads, config.head_dim
+                       ).transpose(1, 0, 2)
         q = apply_rotary(q[None], cos, sin)[0]
         k = apply_rotary(k[None], cos, sin)[0]
         k_pool = k_pool.at[i, page_idx, :, off, :].set(
@@ -258,3 +369,99 @@ def paged_prefill(config: LlamaConfig, params: Params, pools: PagedPools,
     key, sub = jax.random.split(key)
     tok = _sample_tokens(logits, temp[None], sub)[0]
     return tok, key, {"k": k_pool, "v": v_pool}
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def paged_prefill_prefix(config: LlamaConfig, params: Params,
+                         pools: PagedPools, adapters: AdapterArrays,
+                         tokens: jax.Array, prefix_len: jax.Array,
+                         length: jax.Array, page_table: jax.Array,
+                         adapter_id: jax.Array, temp: jax.Array,
+                         key: jax.Array):
+    """Prefill only the SUFFIX of a prompt whose first ``prefix_len``
+    positions are already cached in this sequence's page table (radix
+    prefix-cache hit; shared pages were written by an earlier identical
+    prefill, the COW page by ``copy_page``).
+
+    tokens [1, S_pad] int32 = prompt[prefix_len:] padded to a bucket,
+    prefix_len / length scalars (length = FULL prompt length; both are
+    data, so one compile per bucket serves every split point including
+    mid-page COW divergence).  Suffix K/V is written through the page
+    table at global positions ``prefix_len + row``; rows past the real
+    suffix route to the scratch page (they may not even own a page).
+    Queries then attend the full gathered table like the decode step —
+    cached prefix plus fresh suffix — masked by global causal position.
+    Returns (first_token scalar, new_key, pools)."""
+    _bump("prefill_prefix")
+    _, s_pad = tokens.shape
+    maxp = page_table.shape[0]
+    ps = pools["k"].shape[3]
+    scratch = pools["k"].shape[1] - 1
+    n_rep = config.n_heads // config.n_kv_heads
+    x = params["embed"][tokens[0]].astype(config.dtype)  # [S_pad, d]
+    cos, sin = rope_frequencies(config.head_dim, maxp * ps,
+                                config.rope_theta)
+    k_pool, v_pool = pools["k"], pools["v"]
+    positions = prefix_len + jnp.arange(s_pad)  # global positions
+    valid = positions < length
+    page_idx = jnp.where(
+        valid, page_table[jnp.clip(positions // ps, 0, maxp - 1)], scratch)
+    off = jnp.where(valid, positions % ps, 0)
+    kpos = jnp.arange(maxp * ps)[None, None, :]  # [1, 1, MAXP*ps]
+    qpos = positions[None, :, None]              # [1, S_pad, 1]
+    qa_g, qb_g = adapters["qa"][adapter_id], adapters["qb"][adapter_id]
+    va_g, vb_g = adapters["va"][adapter_id], adapters["vb"][adapter_id]
+    lscale = adapters["scale"][adapter_id]
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        a = layer["attn"]
+        q = (h @ a["wq"] + _lora_delta_seq(h, qa_g[i], qb_g[i], lscale)
+             ).reshape(s_pad, config.n_heads, config.head_dim
+                       ).transpose(1, 0, 2)  # [H, S, D]
+        k = (h @ a["wk"]).reshape(s_pad, config.n_kv_heads, config.head_dim)
+        v = (h @ a["wv"] + _lora_delta_seq(h, va_g[i], vb_g[i], lscale)
+             ).reshape(s_pad, config.n_kv_heads, config.head_dim)
+        # Per-row RoPE at global positions (suffix rows are not at 0).
+        q = _rotary_single(q.transpose(1, 0, 2), cos, sin,
+                           positions).transpose(1, 0, 2)
+        k = _rotary_single(k, cos, sin, positions)
+        k_pool = k_pool.at[i, page_idx, :, off, :].set(
+            k.astype(k_pool.dtype))
+        v_pool = v_pool.at[i, page_idx, :, off, :].set(
+            v.astype(v_pool.dtype))
+        # Gather the WHOLE table (cached prefix + fresh suffix) like the
+        # decode step; causal mask in global positions.
+        k_seq = k_pool[i, page_table].transpose(1, 0, 2, 3).reshape(
+            config.n_kv_heads, maxp * ps, config.head_dim)
+        v_seq = v_pool[i, page_table].transpose(1, 0, 2, 3).reshape(
+            config.n_kv_heads, maxp * ps, config.head_dim)
+        if n_rep > 1:
+            k_seq = jnp.repeat(k_seq, n_rep, axis=0)
+            v_seq = jnp.repeat(v_seq, n_rep, axis=0)
+        scores = jnp.einsum("hqd,hkd->hqk", q.astype(jnp.float32),
+                            k_seq.astype(jnp.float32)) \
+            * (config.head_dim ** -0.5)
+        scores = jnp.where(kpos <= qpos, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+        out = jnp.einsum("hqk,hkd->hqd", probs, v_seq)
+        x = x + out.transpose(1, 0, 2).reshape(s_pad, -1) @ a["wo"]
+        h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        x = x + _mlp(layer, h)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x_last = jnp.take(x, length - prefix_len - 1, axis=0)  # last real row
+    logits = (x_last @ params["lm_head"]).astype(jnp.float32)[None]
+    key, sub = jax.random.split(key)
+    tok = _sample_tokens(logits, temp[None], sub)[0]
+    return tok, key, {"k": k_pool, "v": v_pool}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def copy_page(pools: PagedPools, src: jax.Array,
+              dst: jax.Array) -> PagedPools:
+    """Copy one page's K/V across every layer (copy-on-write when a
+    request diverges mid-page from a cached prefix).  src/dst are data —
+    one compile covers every divergence."""
+    _bump("page_copy")
+    k, v = pools["k"], pools["v"]
+    return {"k": k.at[:, dst].set(k[:, src]),
+            "v": v.at[:, dst].set(v[:, src])}

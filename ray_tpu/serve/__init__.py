@@ -20,23 +20,31 @@ from .api import (
 )
 from .batching import batch
 from .config import deploy as deploy_config
+from .adapter_pool import AdapterNotFoundError, AdapterPool
 from .engine import (
     EngineConfig,
     EngineOverloadedError,
     InferenceEngine,
     LLMServer,
     llm_app,
+    random_lora,
 )
 from .grpc_ingress import start_grpc, stop_grpc
 from .handle import DeploymentHandle, DeploymentResponse
-from .multiplex import get_multiplexed_model_id, multiplexed
+from .multiplex import (
+    get_multiplexed_model_id,
+    multiplexed,
+    pick_replica_for_model,
+)
+from .prefix_cache import RadixPrefixCache
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "delete", "status",
     "shutdown", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "batch", "start_http", "stop_http",
-    "multiplexed", "get_multiplexed_model_id", "deploy_config",
-    "start_grpc", "stop_grpc",
+    "multiplexed", "get_multiplexed_model_id", "pick_replica_for_model",
+    "deploy_config", "start_grpc", "stop_grpc",
     "EngineConfig", "EngineOverloadedError", "InferenceEngine",
-    "LLMServer", "llm_app",
+    "LLMServer", "llm_app", "random_lora",
+    "AdapterPool", "AdapterNotFoundError", "RadixPrefixCache",
 ]

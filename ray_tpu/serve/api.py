@@ -262,6 +262,14 @@ class _HttpProxy:
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n)
                     payload = json.loads(body) if body else None
+                    # Multi-tenant ingress: X-RT-Tenant rides into the
+                    # deployment as the ``tenant`` kwarg so engine-backed
+                    # deployments (LLMServer) apply per-tenant admission
+                    # and accounting.  A tenant already in the body wins —
+                    # the header is the transport-level default.
+                    tenant = (self.headers.get("X-RT-Tenant") or "").strip()
+                    if tenant and isinstance(payload, dict):
+                        payload.setdefault("tenant", tenant)
                     # Stream-mode handles are cached alongside unary ones:
                     # a fresh handle per request would pay a controller
                     # routing RPC and lose the p2c load counts.

@@ -20,6 +20,29 @@ import ray_tpu
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
+def _scale_decision(cur: int, min_r: int, max_r: int,
+                    per_queue: float, target_q: float,
+                    ttft_p90: Optional[float] = None,
+                    target_ttft: Optional[float] = None) -> int:
+    """Pure scaling decision (unit-testable without actors): breach of
+    EITHER signal scales up; scale-down needs BOTH comfortably idle.
+    TTFT is the user-facing SLO — queue depth alone under-scales an
+    engine whose batch is full but whose queue drains slowly (every
+    admitted sequence decodes for many steps, so a short queue can still
+    mean seconds of time-to-first-token)."""
+    breach = per_queue > target_q or (
+        target_ttft is not None and ttft_p90 is not None
+        and ttft_p90 > target_ttft)
+    idle = per_queue < target_q / 2 and (
+        target_ttft is None or ttft_p90 is None
+        or ttft_p90 < target_ttft / 2)
+    if breach and cur < max_r:
+        return cur + 1
+    if idle and not breach and cur > min_r:
+        return cur - 1
+    return cur
+
+
 @ray_tpu.remote(max_concurrency=8)
 class ServeController:
     def __init__(self):
@@ -57,12 +80,19 @@ class ServeController:
 
     def routing_table(self) -> dict:
         """Replica actor handles per deployment (handles reconstruct
-        actor refs on the receiving side)."""
+        actor refs on the receiving side).  ``replica_ids`` carries the
+        stable controller-issued id per replica, position-aligned with
+        ``deployments`` — handles feed them to rendezvous hashing so
+        model affinity survives scale events."""
         with self._lock:
             return {
                 "version": self._version,
                 "deployments": {
                     name: [r["handle"] for r in reps]
+                    for name, reps in self.replicas.items()
+                },
+                "replica_ids": {
+                    name: [r["id"] for r in reps]
                     for name, reps in self.replicas.items()
                 },
             }
@@ -213,8 +243,29 @@ class ServeController:
         if not reps:
             spec.setdefault("_autoscaled", auto["min_replicas"])
             return
-        total_q = 0
+        # SLO path: when the deployment declares target_ttft_s, ask each
+        # replica's user callable for engine signals (LLMServer
+        # .engine_metrics -> InferenceEngine.slo_signals) and scale on
+        # queue depth + recent TTFT p90.  Non-engine replicas (or a
+        # signal call that fails) fall back to the queue-length probe.
+        total_q = 0.0
+        ttfts: List[float] = []
+        target_ttft = auto.get("target_ttft_s")
         for r in reps:
+            sig = None
+            if target_ttft is not None:
+                try:
+                    sig = ray_tpu.get(
+                        r["handle"].handle_request.remote(
+                            "engine_metrics", (), {}),
+                        timeout=5)
+                except Exception:
+                    sig = None
+            if isinstance(sig, dict):
+                total_q += sig.get("queue_depth", 0)
+                if sig.get("ttft_p90_s") is not None:
+                    ttfts.append(sig["ttft_p90_s"])
+                continue
             try:
                 total_q += ray_tpu.get(r["handle"].queue_len.remote(),
                                        timeout=5)
@@ -223,10 +274,9 @@ class ServeController:
         per = total_q / max(1, len(reps))
         target = auto.get("target_ongoing_requests", 2)
         cur = spec.get("_autoscaled", auto["min_replicas"])
-        if per > target and cur < auto["max_replicas"]:
-            cur += 1
-        elif per < target / 2 and cur > auto["min_replicas"]:
-            cur -= 1
+        cur = _scale_decision(
+            cur, auto["min_replicas"], auto["max_replicas"], per, target,
+            max(ttfts) if ttfts else None, target_ttft)
         spec["_autoscaled"] = cur
         with self._lock:
             if name in self.targets:

@@ -65,6 +65,16 @@ class EngineConfig:
     max_queue: int = 32           # admission bound: beyond this, shed
     mode: str = "continuous"      # or "whole_request" (gang admission)
     stream_timeout_s: float = 120.0
+    # Multi-tenant plane.  max_adapters/lora_rank shape the device
+    # adapter pool and are PART of the decode signature — engines that
+    # should share one compiled program must agree on them (like the
+    # geometry above).  prefix_cache toggles the radix tree over the
+    # paged KV; ttft_window sizes the recent-TTFT deque feeding the
+    # controller's SLO autoscaling.
+    max_adapters: int = 4
+    lora_rank: int = 8
+    prefix_cache: bool = True
+    ttft_window: int = 64
 
     @property
     def pages_per_seq(self) -> int:
@@ -100,6 +110,8 @@ class _Request:
         "last_token_t", "itls", "slot",
         "trace_ctx", "submit_wall", "admit_wall", "first_wall",
         "prefill_bucket",
+        "tenant", "weight", "adapter", "adapter_slot", "match",
+        "cow_ref", "cache_hit_len",
     )
 
     def __init__(self, req_id: int, prompt: np.ndarray, max_new: int,
@@ -133,6 +145,17 @@ class _Request:
         self.admit_wall = 0.0
         self.first_wall = 0.0
         self.prefill_bucket = 0
+        # Multi-tenant plane: fair-queue identity, the adapter this
+        # sequence decodes with (None = base model), and the prefix-cache
+        # plan pinned at admission (match + the extra COW-source ref held
+        # until the page is copied).
+        self.tenant = "default"
+        self.weight = 1.0
+        self.adapter: Optional[str] = None
+        self.adapter_slot = -1
+        self.match = None
+        self.cow_ref: Optional[int] = None
+        self.cache_hit_len = 0
 
 
 class TokenStream:
@@ -193,6 +216,18 @@ class InferenceEngine:
         self.pools = init_paged_pools(model_config, cfg.pool_pages,
                                       cfg.page_size)
         self.allocator = PageAllocator(cfg.pool_pages)
+        # Multi-tenant plane: device-resident LoRA slots + the radix
+        # prefix tree over the page pool.  Both are owned by the loop
+        # thread like the allocator.
+        from .adapter_pool import AdapterPool
+        from .prefix_cache import RadixPrefixCache
+
+        self.adapter_pool = AdapterPool(
+            model_config, max_adapters=cfg.max_adapters,
+            rank=cfg.lora_rank)
+        self._cache: Optional[RadixPrefixCache] = (
+            RadixPrefixCache(cfg.page_size) if cfg.prefix_cache else None)
+        self._adapter_evictions_seen = 0
         # ONE device-resident PRNG key threads through every prefill and
         # decode call (each program splits and returns the successor):
         # host-side fold_in per step costs more than the decode math.
@@ -209,14 +244,27 @@ class InferenceEngine:
         self._tokens = np.zeros((b,), np.int32)
         self._active = np.zeros((b,), bool)
         self._temps = np.zeros((b,), np.float32)
+        self._adapter_slots = np.full((b,), self.adapter_pool.zero_slot,
+                                      np.int32)
         self._dirty = True
         self._d_tokens = self._d_page_tables = None
         self._d_seq_lens = self._d_active = self._d_temps = None
+        self._d_adapter_slots = None
         self.step_count = 0
         self._req_counter = 0
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._pending: List[_Request] = []
+        # Weighted-fair admission: one FIFO per tenant, picked by lowest
+        # virtual finish time (classic WFQ — a tenant's vtime advances by
+        # cost/weight per admitted request, clamped to the global vclock
+        # so idle tenants can't bank unbounded credit).
+        self._queues: Dict[str, List[_Request]] = {}
+        self._vtime: Dict[str, float] = {}
+        self._vclock = 0.0
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+        # Control ops (adapter registration, cache clear) marshalled onto
+        # the loop thread: it owns the pools the ops touch.
+        self._control: List[Any] = []
         self._stop = False
         self.completed = 0
         self.shed = 0
@@ -256,6 +304,24 @@ class InferenceEngine:
             "Inter-token latency during decode",
             boundaries=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                         0.25, 1))
+        self._m_pc_hits = get_counter(
+            "ray_tpu_serve_prefix_cache_hits_total",
+            "Prompts whose prefill reused cached KV prefix pages")
+        self._m_pc_shared = get_gauge(
+            "ray_tpu_serve_prefix_cache_pages_shared",
+            "KV pages currently held by more than one owner",
+            tag_keys=("pid",))
+        self._m_adapter_evict = get_counter(
+            "ray_tpu_serve_adapter_evictions_total",
+            "LoRA adapters evicted from the device-resident pool")
+        self._m_tenant_shed = get_counter(
+            "ray_tpu_serve_tenant_shed_total",
+            "Requests shed by weighted-fair admission, by tenant",
+            tag_keys=("tenant",))
+        # Recent TTFTs feeding the controller's SLO autoscaling signal.
+        import collections
+
+        self._ttft_recent = collections.deque(maxlen=cfg.ttft_window)
         import os
 
         self._pid_tags = {"pid": str(os.getpid())}
@@ -267,9 +333,19 @@ class InferenceEngine:
 
     def submit(self, prompt_tokens, max_new_tokens: int = 16,
                temperature: float = 0.0,
-               stop_token: Optional[int] = None) -> TokenStream:
-        """Queue one sequence; returns its token stream.  Sheds with
-        :class:`EngineOverloadedError` when the wait queue is full."""
+               stop_token: Optional[int] = None,
+               adapter: Optional[str] = None,
+               tenant: str = "default",
+               weight: float = 1.0) -> TokenStream:
+        """Queue one sequence; returns its token stream.
+
+        ``adapter`` names a registered LoRA (None = base model);
+        ``tenant``/``weight`` place the request in weighted-fair
+        admission.  Overload sheds the HEAVIEST tenant's newest queued
+        request with :class:`EngineOverloadedError` — when that is the
+        submitter itself the error raises here, otherwise it lands on
+        the victim's stream.  A light tenant is never shed by a heavy
+        one's burst."""
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if prompt.size == 0 or prompt.size > self.config.max_prompt_len:
             raise ValueError(
@@ -278,6 +354,10 @@ class InferenceEngine:
         max_new = min(int(max_new_tokens), self.config.max_new_tokens_cap)
         if max_new <= 0:
             raise ValueError("max_new_tokens must be positive")
+        if weight <= 0:
+            raise ValueError("tenant weight must be positive")
+        if adapter is not None and not self.adapter_pool.has(adapter):
+            raise KeyError(f"adapter {adapter!r} is not registered")
         need = math.ceil((prompt.size + max_new) / self.config.page_size)
         if need > self.allocator.total:
             raise ValueError(
@@ -286,14 +366,15 @@ class InferenceEngine:
         with self._lock:
             if self._stop:
                 raise RuntimeError("engine is shut down")
-            if len(self._pending) >= self.config.max_queue:
-                self.shed += 1
-                self._m_shed.inc(1)
-                raise EngineOverloadedError(
-                    f"engine queue full ({self.config.max_queue} waiting)")
             self._req_counter += 1
             req = _Request(self._req_counter, prompt, max_new,
                            float(temperature), stop_token)
+            req.tenant = tenant
+            req.weight = float(weight)
+            req.adapter = adapter
+            rec = self._tenant_rec(tenant)
+            rec["weight"] = float(weight)
+            rec["submitted"] += 1
             # Capture the submitter's trace context (the replica's
             # execution span in the serve path): the loop thread emits
             # this request's queue/prefill/decode spans against it.
@@ -301,10 +382,61 @@ class InferenceEngine:
 
             req.trace_ctx = tracing.context_for_submit()
             req.submit_wall = time.time()
-            self._pending.append(req)
-            self._m_queue.set(len(self._pending), tags=self._pid_tags)
+            self._queues.setdefault(tenant, []).append(req)
+            victim: Optional[_Request] = None
+            if self._queued_total() > self.config.max_queue:
+                victim = self._shed_locked()
+            self._m_queue.set(self._queued_total(), tags=self._pid_tags)
             self._wake.notify()
+            if victim is req:
+                raise EngineOverloadedError(
+                    f"engine queue full ({self.config.max_queue} "
+                    f"waiting); tenant {tenant!r} is the heaviest")
+            if victim is not None:
+                victim.finished = True
+                victim.out_q.put((
+                    "err", EngineOverloadedError(
+                        f"shed by weighted-fair admission (tenant "
+                        f"{victim.tenant!r} heaviest at overload)"),
+                    self.step_count))
         return TokenStream(self, req)
+
+    def _tenant_rec(self, tenant: str) -> Dict[str, Any]:
+        rec = self._tenants.get(tenant)
+        if rec is None:
+            rec = self._tenants[tenant] = {
+                "submitted": 0, "completed": 0, "shed": 0,
+                "cancelled": 0, "weight": 1.0,
+            }
+        return rec
+
+    def _queued_total(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    @staticmethod
+    def _req_cost(req: _Request) -> float:
+        # Token work (prefill + worst-case decode) as the fair-share unit.
+        return float(req.prompt.size + req.max_new)
+
+    def _shed_locked(self) -> _Request:
+        """Pick the victim: the tenant with the largest queued work per
+        unit weight loses its NEWEST queued request (tail drop — oldest
+        requests are closest to their SLO deadline)."""
+        heaviest, load = None, -1.0
+        for t, q in self._queues.items():
+            if not q:
+                continue
+            w = max(self._tenants[t]["weight"], 1e-9)
+            l = sum(self._req_cost(r) for r in q) / w
+            if l > load:
+                heaviest, load = t, l
+        victim = self._queues[heaviest].pop()
+        rec = self._tenants[heaviest]
+        rec["shed"] += 1
+        self.shed += 1
+        self._m_shed.inc(1)
+        self._m_tenant_shed.inc(1, tags={"tenant": heaviest})
+        return victim
 
     def cancel(self, req: _Request) -> None:
         """Idempotent; a finished request is a no-op.  Pages return to
@@ -321,7 +453,11 @@ class InferenceEngine:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            queued = len(self._pending)
+            queued = self._queued_total()
+            tenants = {
+                t: dict(rec, queued=len(self._queues.get(t, [])))
+                for t, rec in self._tenants.items()
+            }
         active = sum(1 for s in self.slots if s is not None)
         from ..models.paged import trace_count
 
@@ -331,13 +467,87 @@ class InferenceEngine:
             "queued": queued,
             "free_pages": self.allocator.free_count,
             "total_pages": self.allocator.total,
+            "shared_pages": self.allocator.shared_count,
             "completed": self.completed,
             "shed": self.shed,
             "cancelled": self.cancelled_count,
             "decode_traces": trace_count("decode"),
             "prefill_traces": trace_count("prefill"),
+            "prefill_prefix_traces": trace_count("prefill_prefix"),
             "mode": self.config.mode,
+            "tenants": tenants,
+            "prefix_cache": (self._cache.stats()
+                             if self._cache is not None else None),
+            "adapters": self.adapter_pool.stats(),
         }
+
+    def slo_signals(self) -> Dict[str, Any]:
+        """Queue-depth / TTFT snapshot for the controller's SLO-driven
+        autoscaling (cheap: host counters plus a tiny sort)."""
+        ttfts = sorted(self._ttft_recent)
+
+        def pct(p: float) -> float:
+            if not ttfts:
+                return 0.0
+            return ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))]
+
+        with self._lock:
+            queued = self._queued_total()
+        return {
+            "queue_depth": queued,
+            "active_seqs": sum(1 for s in self.slots if s is not None),
+            "batch_slots": self.config.batch_slots,
+            "ttft_p50_s": pct(0.50),
+            "ttft_p90_s": pct(0.90),
+            "ttft_count": len(ttfts),
+            "completed": self.completed,
+            "shed": self.shed,
+        }
+
+    def _run_on_loop(self, fn, timeout: float = 30.0):
+        """Run ``fn`` on the loop thread (it owns pools/cache/adapters)
+        and return its result.  Raises what ``fn`` raised."""
+        done = threading.Event()
+        box: Dict[str, Any] = {}
+
+        def task():
+            try:
+                box["r"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["e"] = e
+            finally:
+                done.set()
+
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("engine is shut down")
+            self._control.append(task)
+            self._wake.notify()
+        if not done.wait(timeout):
+            raise TimeoutError("engine loop did not run control op")
+        if "e" in box:
+            raise box["e"]
+        return box.get("r")
+
+    def register_adapter(self, name: str, source: Any) -> None:
+        """Register (or replace) a LoRA adapter.  Replacement drops any
+        resident copy AND the adapter's prefix-cache tree — its cached V
+        deltas are stale."""
+
+        def do():
+            self.adapter_pool.register(name, source)
+            if self._cache is not None:
+                self._cache.drop_adapter(name, self.allocator)
+
+        self._run_on_loop(do)
+
+    def clear_prefix_cache(self) -> int:
+        """Release every cache-held page ref (tests/bench drain to a
+        balanced free list; compiled programs stay warm)."""
+        if self._cache is None:
+            return 0
+        return self._run_on_loop(
+            lambda: self._cache.clear(self.allocator))
 
     def warmup(self) -> None:
         """Compile the decode program and every prefill bucket up front
@@ -353,6 +563,15 @@ class InferenceEngine:
             s = self.submit(np.ones((n,), np.int32), max_new_tokens=1)
             for _ in s:
                 pass
+        if self._cache is not None \
+                and self.config.max_prompt_len >= self.config.page_size:
+            # Re-run the largest prompt: it hits the pages the line above
+            # cached, compiling the COW copy + suffix-prefill path too.
+            n = self.config.max_prompt_len
+            for _ in self.submit(np.ones((n,), np.int32),
+                                 max_new_tokens=1):
+                pass
+            self.clear_prefix_cache()
 
     # ---------------------------------------------------------------- loop
 
@@ -362,34 +581,86 @@ class InferenceEngine:
                 return b
         return self.config.prefill_buckets()[-1]
 
+    def _pick_tenant_locked(self) -> Optional[str]:
+        """Lowest-virtual-time tenant with queued work (WFQ pick)."""
+        best, best_v = None, None
+        for t, q in self._queues.items():
+            if not q:
+                continue
+            v = max(self._vtime.get(t, 0.0), self._vclock)
+            if best_v is None or v < best_v:
+                best, best_v = t, v
+        return best
+
     def _admit_locked(self) -> List[_Request]:
         """Move queued requests into free slots (called under the lock).
         Continuous mode admits whenever a slot AND pages are free;
-        whole-request mode admits a full gang only into an EMPTY batch."""
+        whole-request mode admits a full gang only into an EMPTY batch.
+        Tenants are drained in weighted-fair order; each admission pins
+        its prefix-cache match (refcounted shares) and allocates only the
+        pages the cache can't cover, evicting cold cache leaves first
+        when the pool runs dry."""
         admitted: List[_Request] = []
         whole = self.config.mode == "whole_request"
         if whole and any(s is not None for s in self.slots):
             return admitted
         for slot in range(self.config.batch_slots):
-            if self.slots[slot] is not None or not self._pending:
+            if self.slots[slot] is not None:
                 continue
-            req = self._pending[0]
-            need = math.ceil((req.prompt.size + req.max_new)
-                             / self.config.page_size)
+            tenant = self._pick_tenant_locked()
+            if tenant is None:
+                continue
+            req = self._queues[tenant][0]
+            if not self.adapter_pool.can_acquire(req.adapter):
+                break  # every adapter slot pinned: wait for an eviction
+            need_total = math.ceil((req.prompt.size + req.max_new)
+                                   / self.config.page_size)
+            match = None
+            shared: List[int] = []
+            if self._cache is not None:
+                match = self._cache.lookup(req.adapter, req.prompt)
+                shared = match.pages
+                # Pin the match BEFORE any cache eviction below can free
+                # the very pages it names.
+                self._cache.claim(match, self.allocator)
+            need = need_total - len(shared)
             pages = self.allocator.alloc(need)
+            if pages is None and self._cache is not None:
+                deficit = need - self.allocator.free_count
+                if self._cache.evict_leaves(deficit, self.allocator):
+                    pages = self.allocator.alloc(need)
             if pages is None:
+                if match is not None:  # roll the claim back
+                    held = list(shared)
+                    if match.cow_src is not None:
+                        held.append(match.cow_src)
+                    if held:
+                        self.allocator.free(held)
                 break  # pool pressure: leave queued, retry next step
-            self._pending.pop(0)
+            self._queues[tenant].pop(0)
+            # Reserve (pin) the adapter slot NOW, host-only: requests
+            # admitted in this same round must see each other's pins, or
+            # a wave of distinct adapters could over-commit the slots the
+            # can_acquire check saw free.  Weights load at prefill.
+            req.adapter_slot = self.adapter_pool.reserve(req.adapter)
+            v_start = max(self._vtime.get(tenant, 0.0), self._vclock)
+            w = max(req.weight, 1e-9)
+            self._vtime[tenant] = v_start + self._req_cost(req) / w
+            self._vclock = v_start
             req.admit_wall = time.time()
-            req.pages = pages
+            req.pages = shared + pages
+            req.match = match
+            if match is not None and match.cow_src is not None:
+                req.cow_ref = match.cow_src
+            req.cache_hit_len = match.prefix_len if match else 0
             pt = np.full((self.maxp,), self.scratch, np.int32)
-            pt[:need] = pages
+            pt[:need_total] = req.pages
             req.page_table = pt
             req.slot = slot
             self.slots[slot] = req
             admitted.append(req)
         if admitted:
-            self._m_queue.set(len(self._pending), tags=self._pid_tags)
+            self._m_queue.set(self._queued_total(), tags=self._pid_tags)
         return admitted
 
     def _emit_req_span(self, req: _Request, name: str, start: float,
@@ -418,8 +689,14 @@ class InferenceEngine:
             if req.first_token_t is not None else None,
             mean_itl_s=round(sum(req.itls) / len(req.itls), 6)
             if req.itls else None)
-        self.allocator.free(req.pages)
-        req.pages = []
+        self.allocator.free(req.pages)  # refcounted: shared prefix
+        req.pages = []                  # pages may stay cached
+        if req.cow_ref is not None:     # evicted before the COW copy ran
+            self.allocator.free([req.cow_ref])
+            req.cow_ref = None
+        if req.adapter_slot >= 0:
+            self.adapter_pool.release(req.adapter)
+            req.adapter_slot = -1
         req.finished = True
         self.slots[slot] = None
         self._page_tables[slot, :] = self.scratch
@@ -427,12 +704,16 @@ class InferenceEngine:
         self._tokens[slot] = 0
         self._active[slot] = False
         self._temps[slot] = 0.0
+        self._adapter_slots[slot] = self.adapter_pool.zero_slot
         self._dirty = True
+        rec = self._tenant_rec(req.tenant)
         if reason == "cancelled":
             self.cancelled_count += 1
+            rec["cancelled"] += 1
             self._m_cancelled.inc(1)
         elif reason in ("complete", "stop"):
             self.completed += 1
+            rec["completed"] += 1
             self._m_completed.inc(1)
         if reason == "shutdown":
             # Loudly: a truncated generation must not look complete.
@@ -443,44 +724,98 @@ class InferenceEngine:
 
     def _prefill(self, req: _Request) -> None:
         """Run one admitted sequence's prompt through the bucketed
-        prefill program and emit its first token (TTFT point)."""
+        prefill program and emit its first token (TTFT point).  A
+        prefix-cache hit copies the COW page (mid-page divergence) and
+        prefills only the uncached suffix."""
         import jax.numpy as jnp
 
-        from ..models.paged import paged_prefill
+        from ..models.paged import (copy_page, paged_prefill,
+                                    paged_prefill_prefix)
 
+        # Admission reserved (pinned) the slot; materialize the weights
+        # if this is the adapter's first use since eviction.
+        self.adapter_pool.ensure_loaded(req.adapter)
+        ev = self.adapter_pool.evictions
+        if ev > self._adapter_evictions_seen:
+            self._m_adapter_evict.inc(ev - self._adapter_evictions_seen)
+            self._adapter_evictions_seen = ev
         n = req.prompt.size
-        s_pad = self._bucket_len(n)
-        req.prefill_bucket = s_pad
         # Queue-wait span (submit -> admission into a batch slot).
         self._emit_req_span(req, "engine:queue", req.submit_wall,
                             req.admit_wall or req.submit_wall,
                             prompt_len=int(n))
         pf_start = time.time()
-        toks = np.zeros((1, s_pad), np.int32)
-        toks[0, :n] = req.prompt
-        first, self._d_key, self.pools = paged_prefill(
-            self.model_config, self.params, self.pools,
-            jnp.asarray(toks), jnp.asarray(n, jnp.int32),
-            jnp.asarray(req.page_table),
-            jnp.asarray(req.temperature, jnp.float32), self._d_key)
+        prefix_len = req.cache_hit_len
+        aid = jnp.asarray(req.adapter_slot, jnp.int32)
+        adapters = self.adapter_pool.arrays
+        if prefix_len > 0:
+            match = req.match
+            if match.cow_src is not None:
+                # Private copy of the divergent page, then drop the
+                # claim's extra ref on the source.
+                dest = int(req.page_table[len(match.pages)])
+                self.pools = copy_page(
+                    self.pools, jnp.asarray(match.cow_src, jnp.int32),
+                    jnp.asarray(dest, jnp.int32))
+                self.allocator.free([req.cow_ref])
+                req.cow_ref = None
+            suffix = req.prompt[prefix_len:]
+            s_pad = self._bucket_len(suffix.size)
+            req.prefill_bucket = s_pad
+            toks = np.zeros((1, s_pad), np.int32)
+            toks[0, :suffix.size] = suffix
+            first, self._d_key, self.pools = paged_prefill_prefix(
+                self.model_config, self.params, self.pools, adapters,
+                jnp.asarray(toks), jnp.asarray(prefix_len, jnp.int32),
+                jnp.asarray(n, jnp.int32), jnp.asarray(req.page_table),
+                aid, jnp.asarray(req.temperature, jnp.float32),
+                self._d_key)
+            self._m_pc_hits.inc(1)
+            self._m_prefill.inc(suffix.size)  # only the work actually done
+        else:
+            s_pad = self._bucket_len(n)
+            req.prefill_bucket = s_pad
+            toks = np.zeros((1, s_pad), np.int32)
+            toks[0, :n] = req.prompt
+            first, self._d_key, self.pools = paged_prefill(
+                self.model_config, self.params, self.pools, adapters,
+                jnp.asarray(toks), jnp.asarray(n, jnp.int32),
+                jnp.asarray(req.page_table), aid,
+                jnp.asarray(req.temperature, jnp.float32), self._d_key)
+            self._m_prefill.inc(n)
         first = int(first)
+        # Cache every fully-frozen prompt page (decode appends past the
+        # prompt, so pages wholly inside it never change again).
+        if self._cache is not None:
+            full = n // self.config.page_size
+            if full > 0:
+                self._cache.insert(
+                    req.adapter, req.prompt[:full * self.config.page_size],
+                    [int(p) for p in req.page_table[:full]],
+                    self.allocator)
+            self._m_pc_shared.set(self.allocator.shared_count,
+                                  tags=self._pid_tags)
         now = time.perf_counter()
         req.length = n
         req.first_token_t = now
         req.last_token_t = now
         req.first_wall = time.time()
-        # Prefill span, bucket attr included: bucket-vs-prompt padding
-        # waste is readable straight off the trace.
+        # Prefill span: bucket + cached-prefix attrs make padding waste
+        # and cache effectiveness readable straight off the trace.
         self._emit_req_span(req, "engine:prefill", pf_start, req.first_wall,
-                            bucket=int(s_pad), prompt_len=int(n))
-        self._m_prefill.inc(n)
-        self._m_ttft.observe(now - req.submit_t)
+                            bucket=int(req.prefill_bucket),
+                            prompt_len=int(n),
+                            cached_prefix=int(prefix_len))
+        ttft = now - req.submit_t
+        self._m_ttft.observe(ttft)
+        self._ttft_recent.append(ttft)
         slot = req.slot
         self._page_tables[slot] = req.page_table
         self._seq_lens[slot] = n
         self._tokens[slot] = first
         self._active[slot] = True
         self._temps[slot] = req.temperature
+        self._adapter_slots[slot] = req.adapter_slot
         self._dirty = True
         self._emit_token(req, first)
 
@@ -512,14 +847,28 @@ class InferenceEngine:
                 error=repr(exc)[:200])
             self.allocator.free(req.pages)
             req.pages = []
+            if req.cow_ref is not None:
+                self.allocator.free([req.cow_ref])
+                req.cow_ref = None
+            if req.adapter_slot >= 0:
+                self.adapter_pool.release(req.adapter)
+                req.adapter_slot = -1
             req.finished = True
             self.slots[slot] = None
             req.out_q.put(("err", exc, self.step_count))
+        # The pools are rebuilt below, so every cached KV page and every
+        # resident adapter slot is garbage: drop the tree's refs and
+        # reset the adapter pool (the registry survives; adapters reload
+        # on next acquire).
+        if self._cache is not None:
+            self._cache.clear(self.allocator)
+        self.adapter_pool.reset()
         self._page_tables[:] = self.scratch
         self._seq_lens[:] = 0
         self._tokens[:] = 0
         self._active[:] = False
         self._temps[:] = 0.0
+        self._adapter_slots[:] = self.adapter_pool.zero_slot
         self._dirty = True
         self.pools = init_paged_pools(
             self.model_config, self.config.pool_pages,
@@ -530,26 +879,33 @@ class InferenceEngine:
             with self._lock:
                 if self._stop:
                     break
+                control, self._control = self._control, []
                 # Reap cancellations first: queued cancels just drop,
                 # in-flight cancels free pages before admission looks at
                 # the pool.
-                keep = []
-                for r in self._pending:
-                    if r.cancelled.is_set():
-                        self.cancelled_count += 1
-                        self._m_cancelled.inc(1)
-                        r.out_q.put(("done", "cancelled", self.step_count))
-                    else:
-                        keep.append(r)
-                if len(keep) != len(self._pending):
-                    self._m_queue.set(len(keep), tags=self._pid_tags)
-                self._pending = keep
+                reaped = False
+                for q in self._queues.values():
+                    keep = []
+                    for r in q:
+                        if r.cancelled.is_set():
+                            self.cancelled_count += 1
+                            self._tenant_rec(r.tenant)["cancelled"] += 1
+                            self._m_cancelled.inc(1)
+                            r.out_q.put(
+                                ("done", "cancelled", self.step_count))
+                            reaped = True
+                        else:
+                            keep.append(r)
+                    q[:] = keep
+                if reaped:
+                    self._m_queue.set(self._queued_total(),
+                                      tags=self._pid_tags)
                 for slot, req in enumerate(self.slots):
                     if req is not None and req.cancelled.is_set():
                         self._evict(slot, "cancelled")
                 admitted = self._admit_locked()
                 active = sum(1 for s in self.slots if s is not None)
-                if not admitted and active == 0:
+                if not admitted and active == 0 and not control:
                     self._m_active.set(0, tags=self._pid_tags)
                     self._m_pages.set(self.allocator.used_count,
                                       tags=self._pid_tags)
@@ -557,14 +913,24 @@ class InferenceEngine:
                     continue
             # Model work runs OUTSIDE the lock: pools/slot arrays belong
             # to this thread; submit() only appends to the wait queue.
+            # Control ops (adapter registration, cache clear) run here
+            # for the same reason.
+            for task in control:
+                task()
             try:
                 self._run_step(admitted)
             except Exception as e:  # noqa: BLE001 — fail streams, not
                 self._fail_inflight(e)  # the loop thread
-        # Shutdown: fail queued + in-flight requests loudly.
+        # Shutdown: fail queued + in-flight requests loudly, and unblock
+        # any control-op waiters.
         with self._lock:
-            pending, self._pending = self._pending, []
+            pending = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            control, self._control = self._control, []
             self._m_queue.set(0, tags=self._pid_tags)
+        for task in control:
+            task()
         for req in pending:
             req.out_q.put(("err", RuntimeError(
                 "engine shut down before admission"), self.step_count))
@@ -591,12 +957,15 @@ class InferenceEngine:
             self._d_seq_lens = jnp.asarray(self._seq_lens)
             self._d_active = jnp.asarray(self._active)
             self._d_temps = jnp.asarray(self._temps)
+            self._d_adapter_slots = jnp.asarray(self._adapter_slots)
             self._dirty = False
         (self._d_tokens, self._d_seq_lens, self._d_key,
          self.pools) = paged_decode_step(
             self.model_config, self.params, self.pools,
+            self.adapter_pool.arrays,
             self._d_tokens, self._d_page_tables, self._d_seq_lens,
-            self._d_active, self._d_temps, self._d_key)
+            self._d_active, self._d_temps, self._d_adapter_slots,
+            self._d_key)
         toks = np.asarray(self._d_tokens)
         now = time.perf_counter()
         for slot, req in enumerate(self.slots):
@@ -643,15 +1012,45 @@ def _b1_config():
     return LlamaConfig.b1(remat=False, dtype=jnp.bfloat16)
 
 
+def random_lora(model_config, seed: int, rank: int = 8,
+                alpha: float = 16.0):
+    """A deterministic nonzero LoRA for tests/bench/demo adapters
+    (``lora_init`` zeroes the B matrices, which would make every adapter
+    a no-op; serving wants adapters that visibly change the logits)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import lora_init
+
+    lora = lora_init(model_config, jax.random.PRNGKey(seed), rank=rank,
+                     alpha=alpha)
+    base = jax.random.PRNGKey(seed ^ 0x5BD1)
+    for i, layer in enumerate(lora["layers"]):
+        kq, kv = jax.random.split(jax.random.fold_in(base, i))
+        layer["wq_lora_b"] = (
+            jax.random.normal(kq, layer["wq_lora_b"].shape, jnp.float32)
+            * 0.05).astype(layer["wq_lora_b"].dtype)
+        layer["wv_lora_b"] = (
+            jax.random.normal(kv, layer["wv_lora_b"].shape, jnp.float32)
+            * 0.05).astype(layer["wv_lora_b"].dtype)
+    return lora
+
+
 class LLMServer:
     """The deployment callable: one engine per replica, tokens streamed
     through serve's per-item streaming path (handle iterators, HTTP SSE,
     gRPC server-streaming).  A consumer that disconnects mid-stream
-    closes the generator, which cancels the request and frees its pages."""
+    closes the generator, which cancels the request and frees its pages.
+
+    Multi-tenant: ``adapter=`` picks a registered LoRA (defaulting to the
+    ambient multiplexed model id, so ``multiplexed_model_id`` routing
+    composes with the engine's batched adapters), ``tenant``/``weight``
+    feed weighted-fair admission."""
 
     def __init__(self, model: str = "tiny",
                  engine: Optional[dict] = None, seed: int = 0,
-                 warmup: bool = False):
+                 warmup: bool = False,
+                 adapters: Optional[Dict[str, Any]] = None):
         import jax
 
         from ..models import llama_init
@@ -660,15 +1059,39 @@ class LLMServer:
         params = llama_init(cfg, jax.random.PRNGKey(seed))
         self.engine = InferenceEngine(
             cfg, params, EngineConfig(**(engine or {})), seed=seed)
+        for name, spec in (adapters or {}).items():
+            self.load_adapter(name, spec)
         if warmup:
             self.engine.warmup()
 
+    def load_adapter(self, name: str, source: Any = None) -> str:
+        """Register a LoRA adapter on this replica's engine.  ``source``
+        is packed arrays / a lora pytree / an object-plane ref / a
+        zero-arg builder, or an int seed (a deterministic random adapter
+        — handy for tests and bench)."""
+        if isinstance(source, int):
+            seed = source
+            cfg = self.engine.model_config
+            rank = self.engine.config.lora_rank
+            source = lambda: random_lora(cfg, seed, rank=rank)  # noqa: E731
+        self.engine.register_adapter(name, source)
+        return name
+
     def __call__(self, prompt_tokens, max_new_tokens: int = 16,
                  temperature: float = 0.0,
-                 stop_token: Optional[int] = None):
+                 stop_token: Optional[int] = None,
+                 adapter: Optional[str] = None,
+                 tenant: str = "default", weight: float = 1.0):
+        if adapter is None:
+            # serve.multiplexed routing: the handle's multiplexed_model_id
+            # arrives via the replica's contextvar.
+            from .multiplex import get_multiplexed_model_id
+
+            adapter = get_multiplexed_model_id() or None
         stream = self.engine.submit(
             prompt_tokens, max_new_tokens=max_new_tokens,
-            temperature=temperature, stop_token=stop_token)
+            temperature=temperature, stop_token=stop_token,
+            adapter=adapter, tenant=tenant, weight=weight)
         try:
             for tok in stream:
                 yield tok
@@ -680,15 +1103,23 @@ class LLMServer:
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
 
+    def engine_metrics(self) -> Dict[str, Any]:
+        """SLO signal snapshot for the controller's autoscaler."""
+        return self.engine.slo_signals()
+
 
 def llm_app(model: str = "tiny", engine: Optional[dict] = None,
             num_replicas: int = 1, name: str = "llm", seed: int = 0,
-            warmup: bool = False):
+            warmup: bool = False,
+            adapters: Optional[Dict[str, Any]] = None):
     """Build a servable LLM application:
     ``serve.run(llm_app(...))`` then stream tokens via
     ``handle.options(stream=True).remote([1, 2, 3], 16)`` or POST with
-    ``Accept: text/event-stream``."""
+    ``Accept: text/event-stream``.  ``adapters`` maps adapter name to an
+    int seed (random adapter) or weight source, registered on every
+    replica at startup."""
     from .api import Deployment
 
     dep = Deployment(LLMServer, name, num_replicas=num_replicas)
-    return dep.bind(model=model, engine=engine, seed=seed, warmup=warmup)
+    return dep.bind(model=model, engine=engine, seed=seed, warmup=warmup,
+                    adapters=adapters)

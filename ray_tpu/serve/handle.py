@@ -147,6 +147,7 @@ class DeploymentHandle:
         self.multiplexed_model_id = multiplexed_model_id
         self.stream = stream
         self._replicas: List[Any] = []
+        self._replica_ids: List[int] = []
         self._version = -1
         self._last_refresh = 0.0
         self._local_load: Dict[int, int] = {}  # replica idx -> outstanding
@@ -181,6 +182,9 @@ class DeploymentHandle:
                 self._replicas = table["deployments"].get(
                     self.deployment_name, []
                 )
+                self._replica_ids = table.get("replica_ids", {}).get(
+                    self.deployment_name, []
+                )
                 self._version = table["version"]
                 self._local_load = {i: 0 for i in range(len(self._replicas))}
             self._last_refresh = now
@@ -188,16 +192,21 @@ class DeploymentHandle:
     def _pick(self) -> int:
         """Power-of-two-choices on the handle's local outstanding counts
         (the client-side view of queue pressure).  Multiplexed requests get
-        hash affinity instead: a model id sticks to one replica so repeated
-        requests hit its warm LRU (reference: the replica scheduler prefers
-        replicas that report the model id as loaded)."""
+        rendezvous-hash affinity over the controller's STABLE replica ids
+        instead: a model id sticks to one replica so repeated requests hit
+        its warm LRU, and adding/removing a replica remaps only the models
+        that must move (modulus hashing over list positions reshuffled
+        nearly every model on any scale event, stranding every warm
+        cache)."""
         n = len(self._replicas)
         if n == 1:
             return 0
         if self.multiplexed_model_id:
-            import zlib
+            from .multiplex import pick_replica_for_model
 
-            return zlib.crc32(self.multiplexed_model_id.encode()) % n
+            ids = self._replica_ids if len(self._replica_ids) == n \
+                else list(range(n))
+            return pick_replica_for_model(self.multiplexed_model_id, ids)
         i, j = random.sample(range(n), 2)
         return i if self._local_load.get(i, 0) <= self._local_load.get(j, 0) \
             else j

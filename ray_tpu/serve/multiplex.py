@@ -28,6 +28,26 @@ def get_multiplexed_model_id() -> str:
     return _current_model_id.get()
 
 
+def pick_replica_for_model(model_id: str, replica_ids) -> int:
+    """Rendezvous (highest-random-weight) hashing: return the INDEX into
+    ``replica_ids`` of the replica that owns ``model_id``.
+
+    Unlike ``hash(model_id) % n``, scaling from n to n+1 replicas only
+    remaps ~1/(n+1) of the model ids — every other model keeps its warm
+    replica-side LRU (reference: the replica scheduler's model-id
+    affinity survives replica-set churn).  ``replica_ids`` must be the
+    controller-issued STABLE ids, not list positions: positions shift on
+    any membership change, stable ids only vanish with their replica."""
+    import hashlib
+
+    best, best_w = 0, b""
+    for i, rid in enumerate(replica_ids):
+        w = hashlib.md5(f"{model_id}:{rid}".encode()).digest()
+        if w > best_w:
+            best_w, best = w, i
+    return best
+
+
 def _set_model_id(model_id: str):
     return _current_model_id.set(model_id)
 

@@ -59,6 +59,11 @@ BUILTIN_METRICS: Dict[str, str] = {
     "ray_tpu_serve_engine_cancelled_total": "counter",
     "ray_tpu_serve_engine_ttft_seconds": "histogram",
     "ray_tpu_serve_engine_itl_seconds": "histogram",
+    # multi-tenant serving plane (serve/engine.py)
+    "ray_tpu_serve_prefix_cache_hits_total": "counter",
+    "ray_tpu_serve_prefix_cache_pages_shared": "gauge",
+    "ray_tpu_serve_adapter_evictions_total": "counter",
+    "ray_tpu_serve_tenant_shed_total": "counter",
     # data (data/dataset.py)
     "ray_tpu_data_rows_total": "counter",
     "ray_tpu_data_stage_seconds_total": "counter",
