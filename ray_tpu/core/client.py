@@ -38,7 +38,9 @@ IDEMPOTENT_METHODS = frozenset({
     # instead of a hard failure across the restart window.
     "get_objects", "wait_objects",
 })
-#: attempts / base delay for the jittered exponential backoff below.
+#: Back-compat aliases: the retry shape now lives in config
+#: (``rpc_retry_attempts`` / ``rpc_retry_base_s``) and the curve in
+#: core/deadline.py — these mirror the defaults for external readers.
 IDEMPOTENT_RETRY_ATTEMPTS = 3
 IDEMPOTENT_RETRY_BASE_S = 0.05
 
@@ -1091,19 +1093,20 @@ class Client:
                     pass
                 raise exceptions.HeadRestartedError(method) from e
         # Idempotent reads survive transient connection hiccups (head busy,
-        # socket reset during a head restart window) with jittered
-        # exponential backoff instead of surfacing the first failure.
-        # Timeouts are NOT retried: a stuck head would just multiply the
-        # caller's wait; only connection-level failures qualify.  When the
-        # connection is genuinely DOWN (head restart window), retries —
-        # with reconnect attempts between them — continue up to the
-        # head_restart_retry_window_s budget: the bounded pause a
+        # socket reset during a head restart window) on the unified
+        # deadline/backoff policy (core/deadline.py).  Timeouts are NOT
+        # retried: a stuck head would just multiply the caller's wait; only
+        # connection-level failures qualify.  When the connection is
+        # genuinely DOWN (head restart window), retries — with reconnect
+        # attempts between them — continue until the outage Deadline
+        # (head_restart_retry_window_s) expires: the bounded pause a
         # head-routed read pays across a head restart.
-        import random
+        from . import deadline as _dl
 
+        policy = _dl.call_policy()
         last: Optional[BaseException] = None
         attempt = 0
-        outage_deadline: Optional[float] = None
+        outage_deadline: Optional[_dl.Deadline] = None
         while True:
             try:
                 return self.rpc.call(method, body, timeout=timeout)
@@ -1112,19 +1115,18 @@ class Client:
                     raise
                 last = e
                 attempt += 1
+                _dl.count_retry("head")
                 closed = bool(getattr(self.rpc, "closed", False))
-                if not closed and attempt >= IDEMPOTENT_RETRY_ATTEMPTS:
+                if not closed and attempt >= get_config().rpc_retry_attempts:
                     raise last
                 if closed:
                     if outage_deadline is None:
-                        outage_deadline = time.monotonic() + \
-                            get_config().head_restart_retry_window_s
-                    if time.monotonic() >= outage_deadline:
+                        outage_deadline = _dl.Deadline.after(
+                            get_config().head_restart_retry_window_s)
+                    if outage_deadline.expired:
+                        _dl.count_deadline_exceeded("head")
                         raise last
-                backoff = min(
-                    IDEMPOTENT_RETRY_BASE_S * (2 ** min(attempt - 1, 4)), 0.5
-                )
-                time.sleep(backoff * (0.5 + random.random()))
+                policy.sleep(attempt, outage_deadline)
                 if self.rpc.closed:
                     # A dead RpcClient never heals on its own (sticky
                     # `closed`): without a fresh connection the remaining

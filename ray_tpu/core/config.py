@@ -63,6 +63,13 @@ SPAWN_ENV_CONTRACT = {
     "RT_HEAD_SESSION": "stable session name for a standalone head — a "
                        "restart keeps the store namespace so pre-crash "
                        "segments stay addressable",
+    # -- fault injection (util/netfault.py) -----------------------------------
+    "RT_NETFAULT": "network fault schedule DSL; every process that opens "
+                   "an RPC endpoint arms it (children inherit the env, so "
+                   "one export perturbs the whole cluster)",
+    "RT_NETFAULT_SEED": "integer seed making the armed schedule's fault "
+                        "sequence replayable (chaos_soak.sh --netfault "
+                        "rotates it and prints the failing value)",
     # -- debug switches -------------------------------------------------------
     "RT_DEBUG_PUSH": "worker-side push/exec tracing to stderr",
     "RT_DEBUG_RPC_ERR": "server-side RPC handler error dumps to stderr",
@@ -156,6 +163,15 @@ class Config:
     # -- RPC ------------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 * 1024 * 1024
+    # Unified retry/backoff policy (core/deadline.py): EVERY retry loop —
+    # idempotent head reads, node/worker reconnect, peer re-dials — backs
+    # off on one jittered exponential curve built from these two knobs,
+    # instead of per-call-site constants (reference:
+    # src/ray/rpc/retryable_grpc_client.h shares one backoff across all
+    # GCS calls).
+    rpc_retry_base_s: float = 0.05
+    rpc_retry_cap_s: float = 0.5
+    rpc_retry_attempts: int = 3
     # -- dataplane (peer-to-peer calls + node-local task leases) --------------
     # Direct actor calls: after a head-mediated address resolution the
     # driver dials the owning worker's peer RPC server and submits actor
@@ -189,6 +205,17 @@ class Config:
     # Peer dials fail fast (a dead worker's address must not stall the
     # caller for the full control-plane connect timeout).
     peer_connect_timeout_s: float = 2.0
+    # In-flight deadline budget for a direct peer call: a submitted call
+    # that hasn't completed within this window is re-routed via the head
+    # and its route quarantined — the gray-failure net that
+    # peer_connect_timeout_s (dial only) cannot catch.  Generous by
+    # default: a legitimately slow actor method must not trip it (the
+    # worker-side dedup cache makes an early re-route harmless, but not
+    # free).
+    peer_call_deadline_s: float = 30.0
+    # How long a quarantined peer route stays degraded to the head path
+    # before the next dial re-probes it.
+    peer_quarantine_probe_s: float = 5.0
     # Control-plane persistence: when set, the head snapshots its durable
     # state (KV table + named-actor specs) here and restores on startup —
     # the analog of GCS fault tolerance via Redis-backed tables

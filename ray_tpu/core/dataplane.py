@@ -56,7 +56,8 @@ class _Slot:
 
     __slots__ = ("addr", "worker_id", "node_id", "session", "object_addr",
                  "bulk_addr", "lease_id", "conn", "in_flight",
-                 "last_progress", "last_active", "dead", "revoked")
+                 "last_progress", "last_active", "dead", "revoked",
+                 "lat_ewma")
 
     def __init__(self, info: dict, conn: RpcClient,
                  lease_id: Optional[bytes] = None):
@@ -77,6 +78,9 @@ class _Slot:
         self.last_active = now  # any traffic; drives the idle-return timer
         self.dead = False
         self.revoked = False
+        # Per-route completion-latency EWMA: the gray-failure signal (a
+        # route can be alive yet uselessly slow).  0.0 = no samples yet.
+        self.lat_ewma = 0.0
 
 
 class _ActorRoute:
@@ -97,7 +101,7 @@ class _ActorRoute:
 
 class _LeasePool:
     __slots__ = ("resources", "slots", "pending", "requesting",
-                 "next_request")
+                 "next_request", "request_at")
 
     def __init__(self, resources: dict):
         self.resources = resources
@@ -105,13 +109,17 @@ class _LeasePool:
         self.pending: deque = deque()  # (call, enqueue_monotonic)
         self.requesting = False
         self.next_request = 0.0
+        # When the in-flight lease_request fired: maintain() resets a
+        # request whose reply never arrived (dropped on the wire), so a
+        # lost grant can't wedge the pool's `requesting` latch forever.
+        self.request_at = 0.0
 
 
 class _DirectCall:
     """One in-flight (or queued) peer submission and its local outcome."""
 
     __slots__ = ("spec", "kind", "slot", "pool", "route", "fut", "finalized",
-                 "done", "event", "share")
+                 "done", "event", "share", "sent_at", "deadline_at")
 
     def __init__(self, spec: dict, kind: str):
         self.spec = spec
@@ -121,6 +129,12 @@ class _DirectCall:
         self.route: Optional[_ActorRoute] = None
         self.fut = None
         self.finalized = False
+        # Watchdog inputs: when the spec hit the wire (0.0 = still queued
+        # client-side) and the caller's absolute budget expiry (0.0 =
+        # none; carried in from spec["deadline_s"] so a re-routed call
+        # can't exceed the original budget).
+        self.sent_at = 0.0
+        self.deadline_at = 0.0
         # True once the call reached a terminal local state: a result
         # descriptor exists, OR the spec was re-routed to the head (the
         # submitter's get()/wait() then follow the head path).  The Event
@@ -161,6 +175,7 @@ class Dataplane:
         "_failed_sends": "_lock",
         "_staged_callbacks": "_lock",
         "_subscribed": "_lock",
+        "_quarantine": "_lock",
         "_peer_loop": "_peer_loop_lock",
     }
 
@@ -178,6 +193,12 @@ class Dataplane:
         self._lease_max = max(1, cfg.lease_max_slots)
         self._idle_return_s = cfg.lease_idle_return_s
         self._peer_timeout = cfg.peer_connect_timeout_s
+        # Gray-failure net: the in-flight budget for a direct call (the
+        # dial-only peer_connect_timeout_s can't see a route that accepted
+        # and then went dark) and the quarantine hold before a re-probe.
+        self._peer_deadline = cfg.peer_call_deadline_s
+        self._probe_s = cfg.peer_quarantine_probe_s
+        self._lease_reply_s = cfg.rpc_connect_timeout_s
         self._lock = make_lock("dataplane.state")
         self._routes: Dict[bytes, _ActorRoute] = {}
         self._pools: Dict[Tuple, _LeasePool] = {}
@@ -200,8 +221,13 @@ class Dataplane:
         self._peer_loop = None
         self._peer_loop_lock = make_lock("dataplane.peer_loop")
         self._subscribed = False
+        # Quarantined peer addrs -> monotonic lift time.  While held, every
+        # dial of the addr degrades to the head path; the first dial past
+        # the lift time IS the re-probe.
+        self._quarantine: Dict[str, float] = {}
         self._direct_counter = None
         self._leased_counter = None
+        self._quarantine_counter = None
         client.rpc.on_push("lease_revoke", self._on_lease_revoke)
 
     # ------------------------------------------------------------ counters
@@ -227,6 +253,19 @@ class Dataplane:
                     "ray_tpu_leased_tasks_total",
                     "Stateless tasks submitted via leased execution slots")
             self._leased_counter.inc()
+        except Exception:
+            pass
+
+    def _count_quarantine(self):
+        try:
+            if self._quarantine_counter is None:
+                from ..util.metrics import get_counter
+
+                self._quarantine_counter = get_counter(
+                    "ray_tpu_peer_quarantines_total",
+                    "Peer routes quarantined for gray failure (stalled or "
+                    "slow-but-alive)")
+            self._quarantine_counter.inc()
         except Exception:
             pass
 
@@ -260,14 +299,50 @@ class Dataplane:
     def _dial(self, info: dict,
               lease_id: Optional[bytes] = None) -> Optional[_Slot]:
         """Dial a peer endpoint (blocking, short timeout).  Never call on
-        an RPC loop thread."""
+        an RPC loop thread.  Quarantined addrs return None (head path)
+        until their lift time; the first dial past it is the re-probe."""
+        addr = info["addr"]
+        with self._lock:
+            lift = self._quarantine.get(addr)
+            if lift is not None:
+                now = time.monotonic()
+                if now < lift:
+                    return None
+                # Re-probe window claimed: exactly one dial tests the
+                # route; concurrent dials keep degrading until it lands.
+                self._quarantine[addr] = now + self._probe_s
         try:
-            conn = RpcClient(*_split(info["addr"]), name="peer-direct",
+            conn = RpcClient(*_split(addr), name="peer-direct",
                              connect_timeout_s=self._peer_timeout,
                              loop=self._get_peer_loop())
         except Exception:
+            if lift is not None:
+                with self._lock:
+                    # Failed re-probe: stay quarantined for another hold.
+                    self._quarantine[addr] = \
+                        time.monotonic() + self._probe_s
             return None
+        if lift is not None:
+            with self._lock:
+                self._quarantine.pop(addr, None)  # probe succeeded
         return _Slot(info, conn, lease_id)
+
+    def _quarantine_route_locked(self, slot: _Slot,
+                                 route: Optional[_ActorRoute]):
+        """Lock held.  Gray failure on a peer route (stalled in-flight
+        call, or completion EWMA degraded past the budget): take the addr
+        out of service until a re-probe, retire the slot, and detach every
+        actor route pinned to it so their next call re-resolves (and,
+        while the quarantine holds, runs via the head)."""
+        self._quarantine[slot.addr] = time.monotonic() + self._probe_s
+        if not slot.dead:
+            self._retire_slot(slot)
+        if route is not None and route.slot is slot:
+            route.slot = None
+        for r in self._routes.values():
+            if r.slot is slot:
+                r.slot = None
+        self._count_quarantine()
 
     def _retire_slot(self, slot: _Slot):
         """Lock held.  Take a slot out of service; its connection is closed
@@ -589,6 +664,7 @@ class Dataplane:
         if want <= 0:
             return
         pool.requesting = True
+        pool.request_at = now  # maintain() unwedges a reply lost in flight
         try:
             fut = self._client.rpc.call_async(
                 "lease_request",
@@ -765,6 +841,10 @@ class Dataplane:
         call = _DirectCall(spec, kind)
         call.route = route
         call.pool = pool
+        if spec.get("deadline_s") is not None:
+            # Caller-supplied budget (absolute from admission): survives
+            # re-routes — a retried call can't exceed the original budget.
+            call.deadline_at = time.monotonic() + float(spec["deadline_s"])
         for raw in spec.get("return_ids", []):
             self._calls[raw] = call
         self._task_calls[spec["task_id"]] = call
@@ -778,6 +858,7 @@ class Dataplane:
         slot.in_flight += 1
         now = time.monotonic()
         slot.last_active = now
+        call.sent_at = now  # watchdog baseline for the in-flight budget
         if spec.get("num_returns") == "streaming":
             self._stream_routes[spec["task_id"]] = slot
         if slot.conn.closed:
@@ -819,6 +900,11 @@ class Dataplane:
             self._stream_routes.pop(spec["task_id"], None)
             release = self._unpin_args(spec)
         spec = {k: v for k, v in spec.items() if not k.startswith("_")}
+        if call.deadline_at:
+            # Remaining budget rides the spec: the head-path retry of this
+            # call inherits what's left, never a fresh window.
+            spec["deadline_s"] = max(
+                0.0, call.deadline_at - time.monotonic())
         if decrement_retries:
             retries = spec.get("max_retries", 0)
             if retries > 0:
@@ -1003,6 +1089,18 @@ class Dataplane:
                     now = time.monotonic()
                     slot.last_progress = now
                     slot.last_active = now
+                    if call.sent_at:
+                        # Route-latency EWMA: completions that keep taking
+                        # a large fraction of the deadline budget mark a
+                        # slow-but-alive route — quarantine it before the
+                        # watchdog has to (the other gray-failure net).
+                        dt = now - call.sent_at
+                        slot.lat_ewma = dt if slot.lat_ewma == 0.0 \
+                            else 0.8 * slot.lat_ewma + 0.2 * dt
+                        if slot.lat_ewma > 0.5 * self._peer_deadline \
+                                and not slot.dead \
+                                and slot.addr not in self._quarantine:
+                            self._quarantine_route_locked(slot, call.route)
                     if slot.revoked and slot.in_flight == 0 \
                             and slot.lease_id is not None:
                         self._retire_slot(slot)
@@ -1173,18 +1271,36 @@ class Dataplane:
                 self._stream_routes.pop(task_id, None)
             return {"error": serialization.pack(exceptions.WorkerCrashedError(
                 "worker died mid-stream (direct streaming task)"))}
-        try:
-            reply = slot.conn.call(
-                "peer_next_stream_item",
-                {"task_id": task_id, "index": index,
-                 "worker_id": slot.worker_id},
-                timeout=1e9,
-            )
-        except Exception:
-            with self._lock:
-                self._stream_routes.pop(task_id, None)
-            return {"error": serialization.pack(exceptions.WorkerCrashedError(
-                "worker died mid-stream (direct streaming task)"))}
+        # Bounded, retried pull (was timeout=1e9, which a mid-stream
+        # partition turned into a forever-hang): the pull is idempotent —
+        # indexed reads re-issue safely — so each attempt gets one deadline
+        # budget; a route that stays dark past the retry budget fails
+        # typed and is quarantined.
+        reply = None
+        attempts = 0
+        while True:
+            try:
+                reply = slot.conn.call(
+                    "peer_next_stream_item",
+                    {"task_id": task_id, "index": index,
+                     "worker_id": slot.worker_id},
+                    timeout=self._peer_deadline,
+                )
+                break
+            except Exception:
+                attempts += 1
+                from . import deadline as _dl
+
+                _dl.count_retry("stream")
+                if slot.conn.closed or attempts >= 3:
+                    with self._lock:
+                        self._stream_routes.pop(task_id, None)
+                        if not slot.dead:
+                            self._quarantine_route_locked(slot, None)
+                    return {"error": serialization.pack(
+                        exceptions.WorkerCrashedError(
+                            "worker unreachable mid-stream (direct "
+                            "streaming task)"))}
         if reply.get("stale"):
             with self._lock:
                 self._stream_routes.pop(task_id, None)
@@ -1216,6 +1332,24 @@ class Dataplane:
         return {"object_id": raw}
 
     # -- cancellation ----------------------------------------------------------
+
+    def _seal_call_error(self, call: _DirectCall, exc: BaseException):
+        """Seal a call locally with a typed error (deadline expiry; the
+        local analog of cancel_task's queued-call seal).  Never under the
+        lock on entry."""
+        err = serialization.pack(exc)
+        with self._lock:
+            if call.finalized:
+                return
+            call.finalized = True
+            self._seal_result(call, uniform={"error": err})
+            release = self._unpin_args(call.spec)
+            self._stream_routes.pop(call.spec["task_id"], None)
+            call.done = True
+            ev = call.event
+        if ev is not None:
+            ev.set()
+        self._queue_frees(release)
 
     def cancel_task(self, task_raw: bytes, force: bool) -> bool:
         """True when the task was a direct call and the cancel was routed
@@ -1326,8 +1460,36 @@ class Dataplane:
         renew: List[bytes] = []
         returns: List[bytes] = []
         flush: List[_DirectCall] = []
+        overdue: List[_DirectCall] = []
+        expired: List[_DirectCall] = []
         with self._lock:
             conns, self._retired_conns = self._retired_conns, []
+            # Gray-failure watchdog: an in-flight direct call past the
+            # deadline budget means its route is partitioned or wedged —
+            # the dial succeeded, so peer_connect_timeout_s can't see it
+            # (a one-way partition that drops only replies looks exactly
+            # like this).  Quarantine the route; past the caller's own
+            # budget the call seals DeadlineExceededError, otherwise it
+            # re-routes via the head — worker-side dedup makes the
+            # redelivery safe even when the peer DID execute and only the
+            # reply was lost, so the retry budget is not charged.
+            for call in list(self._task_calls.values()):
+                if call.finalized or call.slot is None or not call.sent_at:
+                    continue
+                if call.deadline_at and now >= call.deadline_at:
+                    if not call.slot.dead:
+                        self._quarantine_route_locked(call.slot, call.route)
+                    expired.append(call)
+                elif now - call.sent_at > self._peer_deadline:
+                    if not call.slot.dead:
+                        self._quarantine_route_locked(call.slot, call.route)
+                    overdue.append(call)
+            # Lift bookkeeping: a quarantine whose lift time passed long
+            # ago with no dial re-probing it (route abandoned) is pruned
+            # so the table can't grow across peer churn.
+            for addr in [a for a, t in self._quarantine.items()
+                         if now - t > 60.0]:
+                self._quarantine.pop(addr, None)
             # Prune terminal actor routes (dead, nothing queued): route
             # state must not accumulate across actor churn in long-lived
             # drivers.
@@ -1335,6 +1497,13 @@ class Dataplane:
                         if route.dead and not route.pending]:
                 self._routes.pop(raw, None)
             for pool in self._pools.values():
+                if pool.requesting and pool.request_at \
+                        and now - pool.request_at > self._lease_reply_s:
+                    # The grant reply never arrived (lost on the wire, or
+                    # the head restarted mid-request): release the latch
+                    # so the pool can re-request instead of starving.
+                    pool.requesting = False
+                    pool.next_request = now + 0.5
                 for slot in list(pool.slots):
                     if slot.dead:
                         pool.slots.remove(slot)
@@ -1378,6 +1547,18 @@ class Dataplane:
                                           {"lease_ids": renew})
         except Exception:
             pass
+        if expired or overdue:
+            from . import deadline as _dl
+
+            for call in expired:
+                _dl.count_deadline_exceeded("peer")
+                self._seal_call_error(call, exceptions.DeadlineExceededError(
+                    f"direct call {call.spec.get('name', '')!r} exceeded "
+                    "its deadline budget"))
+            for call in overdue:
+                _dl.count_retry("peer")
+                # No retry charge: the redelivery dedups worker-side.
+                self._fallback_to_head(call, decrement_retries=False)
         self._submit_calls_via_head(flush)
 
     def close(self):

@@ -222,7 +222,7 @@ class Head:
     def __init__(self, config: Config, session: str, host: str = "127.0.0.1"):
         self.config = config
         self.session = session
-        self.server = RpcServer(host=host)
+        self.server = RpcServer(host=host, name="head-server")
         self.scheduler = ClusterScheduler(config.scheduler_spread_threshold)
         self.host = host
         self.port = 0

@@ -300,7 +300,7 @@ class NodeDaemon:
         self.store = ObjectStore(
             self.session, cfg.object_store_memory, cfg.spill_dir
         )
-        self.server = RpcServer(host=self.host)
+        self.server = RpcServer(host=self.host, name="node-server")
         self.server.register("pull_object", make_pull_handler(self.store))
         self.server.register("read_log", make_log_read_handler())
         self.server.register("ping", lambda conn, body: {"ok": True})
@@ -506,16 +506,18 @@ class NodeDaemon:
                          name="head-reconnect").start()
 
     def _reconnect_loop(self):
-        import random
+        from . import deadline as _dl
 
-        deadline = get_config().head_reconnect_deadline_s
-        start = time.monotonic()
-        backoff = 0.1
+        budget = get_config().head_reconnect_deadline_s
+        deadline = _dl.Deadline.after(budget)
+        policy = _dl.reconnect_policy()
+        attempt = 0
         while not self._shutdown.is_set():
-            if time.monotonic() - start > deadline:
+            if deadline.expired:
+                _dl.count_deadline_exceeded("reconnect")
                 print(
                     f"ray_tpu node daemon (session {self.session}): head "
-                    f"did not return within {deadline:.0f}s "
+                    f"did not return within {budget:.0f}s "
                     "(head_reconnect_deadline_s); shutting the node down",
                     file=sys.stderr, flush=True,
                 )
@@ -535,8 +537,9 @@ class NodeDaemon:
                 return
             except Exception:
                 pass
-            time.sleep(backoff * (0.5 + random.random()))
-            backoff = min(backoff * 2, 2.0)
+            attempt += 1
+            _dl.count_retry("reconnect")
+            policy.sleep(attempt, deadline)
 
     def _reconnect_once(self):
         """One redial + re-register carrying this node's field state; on

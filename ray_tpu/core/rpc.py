@@ -17,6 +17,7 @@ import struct
 import sys
 import threading
 import traceback
+from concurrent.futures import TimeoutError as _cf_TimeoutError
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
@@ -27,6 +28,43 @@ REQ, RESP, ERR, PUSH = 0, 1, 2, 3
 _HDR = struct.Struct("<I")
 
 _max_msg_bytes: Optional[int] = None
+
+# -- network fault injection (util/netfault.py) ------------------------------
+# The armed FaultSchedule, or None.  Hot paths (per-frame send/receive)
+# check this one global against None and touch nothing else — the injector
+# hook is free when disabled.  Armed lazily from RT_NETFAULT at the first
+# endpoint construction, or programmatically via set_fault_schedule.
+_netfault = None
+_netfault_env_checked = False
+
+
+def _maybe_arm_netfault():
+    global _netfault, _netfault_env_checked
+    if _netfault_env_checked:
+        return
+    _netfault_env_checked = True
+    import os
+
+    spec = os.environ.get("RT_NETFAULT")
+    if not spec:
+        return
+    try:
+        from ..util.netfault import FaultSchedule
+
+        _netfault = FaultSchedule(
+            spec, int(os.environ.get("RT_NETFAULT_SEED", "0") or 0))
+        print(f"netfault: armed seed={_netfault.seed} spec={spec!r}",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # a bad spec must be loud, not a silent no-op
+        print(f"netfault: failed to arm {spec!r}: {e}",
+              file=sys.stderr, flush=True)
+
+
+def set_fault_schedule(sched):
+    """Install (or clear, with None) the process's fault schedule."""
+    global _netfault, _netfault_env_checked
+    _netfault = sched
+    _netfault_env_checked = True
 
 
 def _msg_limit() -> int:
@@ -124,9 +162,12 @@ class RpcServer:
                     "registration",
     }
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "server"):
+        _maybe_arm_netfault()
         self.host = host
         self.port = port
+        self.name = name  # netfault link id (e.g. "peer-server")
         self.handlers: Dict[str, Callable[[Connection, Any], Awaitable[Any]]] = {}
         self.connections: Dict[int, Connection] = {}
         self.on_disconnect: Optional[Callable[[Connection], Awaitable[None]]] = None
@@ -170,6 +211,13 @@ class RpcServer:
         conn = Connection(reader, writer, self)
         self.connections[conn.conn_id] = conn
         try:
+            if _netfault is not None:
+                # Gray failure: the accept succeeded (the peer sees a live
+                # TCP endpoint) but nothing is read — and therefore nothing
+                # is ever answered — until the stall window closes.
+                stall_s = _netfault.on_accept(self.name)
+                if stall_s > 0:
+                    await asyncio.sleep(stall_s)
             while True:
                 mtype, seq, method, body = await _read_msg(reader)
                 if mtype == REQ:
@@ -233,13 +281,20 @@ class RpcClient:
                               "racing read in the reader's teardown just "
                               "runs the old callback once, which close() "
                               "tolerates",
+        "_pending": "seq-keyed entries: the loop thread sets and pops them; "
+                    "call()'s timeout abandon pops only its OWN seq from "
+                    "the caller thread (GIL-atomic dict.pop — one pop wins "
+                    "and the loser's fut.done() check makes a double "
+                    "resolve impossible); the dict itself is never rebound",
     }
 
     def __init__(self, host: str, port: int, name: str = "rpc-client",
                  connect_timeout_s: Optional[float] = None,
                  loop: Optional[asyncio.AbstractEventLoop] = None):
+        _maybe_arm_netfault()
         self.host = host
         self.port = port
+        self.name = name  # netfault rules link-match on this
         # ``loop``: run on a caller-owned shared loop instead of spawning a
         # thread per connection — the peer dataplane multiplexes many
         # worker connections over ONE loop thread (a reader thread per
@@ -298,24 +353,37 @@ class RpcClient:
             self._read_loop()
         )
 
+    def _handle_msg(self, mtype, seq, method, body):
+        if mtype in (RESP, ERR):
+            fut = self._pending.pop(seq, None)
+            if fut is not None and not fut.done():
+                if mtype == RESP:
+                    fut.set_result(body)
+                else:
+                    fut.set_exception(RpcError(body))
+        elif mtype == PUSH:
+            fn = self._push_handlers.get(method)
+            if fn is not None:
+                try:
+                    fn(body)
+                except Exception:
+                    traceback.print_exc()
+
     async def _read_loop(self):
         try:
             while True:
                 mtype, seq, method, body = await _read_msg(self._reader)
-                if mtype in (RESP, ERR):
-                    fut = self._pending.pop(seq, None)
-                    if fut is not None and not fut.done():
-                        if mtype == RESP:
-                            fut.set_result(body)
-                        else:
-                            fut.set_exception(RpcError(body))
-                elif mtype == PUSH:
-                    fn = self._push_handlers.get(method)
-                    if fn is not None:
-                        try:
-                            fn(body)
-                        except Exception:
-                            traceback.print_exc()
+                nf = _netfault
+                if nf is not None:
+                    act = nf.on_recv(self.name, method)
+                    if act is not None:
+                        if act["kind"] == "drop":
+                            continue  # reply lost on the wire
+                        if act["kind"] == "dup":
+                            # Deliver twice: the second delivery exercises
+                            # the abandoned-seq / double-resolve surface.
+                            self._handle_msg(mtype, seq, method, body)
+                self._handle_msg(mtype, seq, method, body)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
                 asyncio.CancelledError):
             pass  # CancelledError: voluntary close() tearing the task down
@@ -329,7 +397,9 @@ class RpcClient:
         finally:
             self.closed = True
             self._fail_outbox()
-            for fut in self._pending.values():
+            # list(): call()'s timeout abandon pops entries from a foreign
+            # thread; iterate a snapshot, pop-racers are already resolved.
+            for fut in list(self._pending.values()):
                 if not fut.done():
                     fut.set_exception(ConnectionLost("connection lost"))
             self._pending.clear()
@@ -363,6 +433,7 @@ class RpcClient:
                     return
             data = bytearray()
             written: list = []
+            nf = _netfault
             for seq, method, body, fut in batch:
                 if fut.done():
                     continue  # e.g. cancelled while queued
@@ -371,6 +442,22 @@ class RpcClient:
                 except Exception as e:  # oversized message etc.
                     fut.set_exception(e)
                     continue
+                if nf is not None:
+                    act = nf.on_send(self.name, method)
+                    if act is not None:
+                        if act["kind"] == "drop":
+                            # Lost on the wire: the caller still awaits a
+                            # reply that never comes, exactly like a real
+                            # dropped packet — pending registered, frame
+                            # never written.
+                            self._pending[seq] = fut
+                            continue
+                        if act["kind"] == "delay":
+                            self._pending[seq] = fut
+                            self._loop.call_later(
+                                act["delay_s"], self._write_late,
+                                bytes(frame))
+                            continue
                 self._pending[seq] = fut
                 written.append(seq)
                 data += frame
@@ -394,10 +481,32 @@ class RpcClient:
         # iteration (reads must not starve) and keep the flag claimed.
         self._loop.call_soon(self._drain_outbox)
 
+    def _write_late(self, frame: bytes):
+        """Loop thread, via call_later: a netfault-delayed frame finally
+        hits the wire (unless the connection died meanwhile)."""
+        if self.closed or self._writer is None:
+            return
+        try:
+            self._writer.write(frame)
+        except Exception:
+            pass  # read loop's teardown already failed the pending future
+
     def call(self, method: str, body: Any = None, timeout: float = 60.0) -> Any:
         if self.closed:
             raise ConnectionLost("client is closed")
-        return self.call_async(method, body).result(timeout=timeout)
+        fut = self.call_async(method, body)
+        try:
+            return fut.result(timeout=timeout)
+        except _cf_TimeoutError:
+            # Abandon the call: drop the pending entry so a late reply to
+            # this seq is a silent no-op instead of a leaked future, and
+            # cancel() so a queued-but-unsent request never hits the wire.
+            self._pending.pop(getattr(fut, "_rt_seq", -1), None)
+            fut.cancel()
+            from .deadline import count_deadline_exceeded
+
+            count_deadline_exceeded(self.name)
+            raise
 
     def call_async(self, method: str, body: Any = None):
         """Fire a request, return a concurrent.futures.Future.  Requests
@@ -411,6 +520,7 @@ class RpcClient:
             return fut
         with self._seq_lock:
             self._seq += 1
+            fut._rt_seq = self._seq  # call()'s timeout abandon keys on this
             self._outbox.append((self._seq, method, body, fut))
             wake = not self._outbox_scheduled
             if wake:

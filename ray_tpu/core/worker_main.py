@@ -26,6 +26,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
@@ -167,6 +168,8 @@ class Worker:
         "_direct_replies": "_direct_replies_lock",
         "_direct_replies_scheduled": "_direct_replies_lock",
         "_reconnecting": "_reconnect_guard",
+        "_done_cache": "_dedup_lock",
+        "_dedup_running": "_dedup_lock",
     }
     # Intentional cross-thread handoffs, vetted per CONTRIBUTING's
     # thread-role model: each is either ordered by the task queue (the
@@ -217,7 +220,7 @@ class Worker:
         # completion marks): every direct_streams access holds this.
         self._streams_lock = make_lock("worker.streams")
         peer_host = os.environ.get("RT_PEER_HOST", "127.0.0.1")
-        self.peer_server = RpcServer(host=peer_host)
+        self.peer_server = RpcServer(host=peer_host, name="peer-server")
         self.peer_server.register("peer_submit", self.h_peer_submit)
         self.peer_server.register("peer_next_stream_item",
                                   self.h_peer_next_stream_item)
@@ -269,6 +272,17 @@ class Worker:
         self.async_loop: Optional[asyncio.AbstractEventLoop] = None
         self.running_threads: Dict[bytes, int] = {}  # task_id -> thread ident
         self.cancelled: set = set()
+        # Duplicate-delivery dedup: retries and re-routes (a direct call
+        # degraded to the head path after its reply was lost, a head
+        # re-dispatch across a partition) may deliver the SAME task_id
+        # twice.  Completed results are cached (bounded, oldest-first
+        # eviction) and replayed instead of re-executed; a duplicate of a
+        # STILL-RUNNING task parks until the original completes
+        # (reference: task_id-keyed dedup in the reference's actor task
+        # submission — the receiver, not the network, owns exactly-once).
+        self._dedup_lock = make_lock("worker.dedup")
+        self._done_cache: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._dedup_running: Dict[bytes, list] = {}
         self._shutdown = threading.Event()
 
         def _on_exec(spec):
@@ -590,47 +604,72 @@ class Worker:
         return {"object_id": oid.binary(), "size": size}
 
     def _report_done(self, spec, returns=None, error=None, retryable=False,
-                     error_repr="", error_tb="", stream_count=0):
+                     error_repr="", error_tb="", stream_count=0,
+                     _replay=False):
+        parked: list = []
+        if not _replay:
+            with self._dedup_lock:
+                if error is None or not retryable:
+                    # Retryable errors are NOT cached: the head re-issues a
+                    # failed-retryable task under the SAME task_id, and a
+                    # cached error would wrongly short-circuit the retry.
+                    self._done_cache[spec["task_id"]] = {
+                        "returns": returns or [], "error": error,
+                        "retryable": retryable, "error_repr": error_repr,
+                        "error_tb": error_tb, "stream_count": stream_count,
+                    }
+                    while len(self._done_cache) > 1024:
+                        self._done_cache.popitem(last=False)
+                parked = self._dedup_running.pop(spec["task_id"], [])
         direct_reply = spec.pop("_direct_reply", None)
         if direct_reply is not None:
             self._reply_direct(spec, direct_reply, returns or [], error,
                                retryable, error_repr, error_tb, stream_count)
-            return
-        body = {
-            "task_id": spec["task_id"],
-            "returns": returns or [],
-            "stream_count": stream_count,
-        }
-        if error is not None:
-            body["error"] = error
-            body["retryable"] = retryable
-            body["error_repr"] = error_repr
-            # Full traceback text: retained in the head's task-event
-            # history so post-hoc debugging doesn't need the (possibly
-            # unserializable or already-freed) exception object.
-            body["error_tb"] = error_tb
-            body["returns"] = [
-                {"object_id": raw} for raw in spec.get("return_ids", [])
-            ]
-        try:
-            # Pipelined + batched: the worker moves on without a round trip,
-            # and a burst of completions coalesces into one head RPC; the
-            # run loop flushes when its queue drains (reference: PushTask
-            # replies carry results asynchronously).
-            self.client.call_batched("task_done", body)
-            if self.task_queue.empty():
-                # No follow-up work: the caller is blocking on this result.
-                self.client._flush_submit_batch()
-            if _DEBUG_PUSH:
-                print(f"DONE-SENT {spec.get('name')} "
-                      f"{spec['task_id'].hex()[:8]}", file=sys.stderr,
-                      flush=True)
-        except Exception:
-            if _DEBUG_PUSH:
-                print(f"DONE-FAIL {spec.get('name')}: "
-                      f"{traceback.format_exc()}", file=sys.stderr,
-                      flush=True)
-            os._exit(1)
+        else:
+            body = {
+                "task_id": spec["task_id"],
+                "returns": returns or [],
+                "stream_count": stream_count,
+            }
+            if error is not None:
+                body["error"] = error
+                body["retryable"] = retryable
+                body["error_repr"] = error_repr
+                # Full traceback text: retained in the head's task-event
+                # history so post-hoc debugging doesn't need the (possibly
+                # unserializable or already-freed) exception object.
+                body["error_tb"] = error_tb
+                body["returns"] = [
+                    {"object_id": raw} for raw in spec.get("return_ids", [])
+                ]
+            try:
+                # Pipelined + batched: the worker moves on without a round
+                # trip, and a burst of completions coalesces into one head
+                # RPC; the run loop flushes when its queue drains
+                # (reference: PushTask replies carry results
+                # asynchronously).
+                self.client.call_batched("task_done", body)
+                if self.task_queue.empty():
+                    # No follow-up work: the caller is blocking on this
+                    # result.
+                    self.client._flush_submit_batch()
+                if _DEBUG_PUSH:
+                    print(f"DONE-SENT {spec.get('name')} "
+                          f"{spec['task_id'].hex()[:8]}", file=sys.stderr,
+                          flush=True)
+            except Exception:
+                if _DEBUG_PUSH:
+                    print(f"DONE-FAIL {spec.get('name')}: "
+                          f"{traceback.format_exc()}", file=sys.stderr,
+                          flush=True)
+                os._exit(1)
+        # Duplicates that arrived while this task ran: answer them with the
+        # SAME completion — never a second execution.
+        for dup in parked:
+            self._report_done(dup, returns=returns, error=error,
+                              retryable=retryable, error_repr=error_repr,
+                              error_tb=error_tb, stream_count=stream_count,
+                              _replay=True)
 
     def _reply_direct(self, spec, direct_reply, returns, error, retryable,
                       error_repr, error_tb, stream_count):
@@ -735,6 +774,24 @@ class Worker:
             # Stale incarnation: this process never hosted (or no longer
             # hosts) that actor — the caller must re-resolve via the head.
             return {"stale": True}
+        with self._dedup_lock:
+            rec = self._done_cache.get(spec["task_id"])
+        if rec is not None:
+            # Duplicate delivery (reply lost, submitter re-routed or the
+            # injector duplicated the request): answer from the completion
+            # cache — the task must not run twice.
+            reply = {
+                "returns": rec["returns"],
+                "stream_count": rec["stream_count"],
+                "session": self.client.session,
+                "node_id": self.node_id,
+            }
+            if rec["error"] is not None:
+                reply["error"] = rec["error"]
+                reply["retryable"] = rec["retryable"]
+                reply["error_repr"] = rec["error_repr"]
+                reply["error_tb"] = rec["error_tb"]
+            return reply
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         spec["_direct_reply"] = (loop, fut)
@@ -788,6 +845,25 @@ class Worker:
 
     def _execute(self, spec):
         task_id = spec["task_id"]
+        # Duplicate-delivery gate: a completed task_id replays its cached
+        # completion; a dup of a STILL-RUNNING task parks and is answered
+        # by the original's _report_done.  Either way: no second execution.
+        with self._dedup_lock:
+            rec = self._done_cache.get(task_id)
+            if rec is None:
+                if task_id in self._dedup_running:
+                    self._dedup_running[task_id].append(spec)
+                    return
+                self._dedup_running[task_id] = []
+        if rec is not None:
+            self._report_done(spec, returns=rec["returns"],
+                              error=rec["error"],
+                              retryable=rec["retryable"],
+                              error_repr=rec["error_repr"],
+                              error_tb=rec["error_tb"],
+                              stream_count=rec["stream_count"],
+                              _replay=True)
+            return
         if _DEBUG_PUSH:
             print(f"EXEC start {spec.get('name')} {task_id.hex()[:8]}",
                   file=sys.stderr, flush=True)
@@ -1169,16 +1245,18 @@ class Worker:
         working: task threads run, peer_submit keeps accepting direct
         calls, and completed head-routed reports buffer in the client for
         replay at re-register."""
-        import random
+        from . import deadline as _dl
 
-        deadline = get_config().head_reconnect_deadline_s
-        start = time.monotonic()
-        backoff = 0.1
+        budget = get_config().head_reconnect_deadline_s
+        deadline = _dl.Deadline.after(budget)
+        policy = _dl.reconnect_policy()
+        attempt = 0
         while not self._shutdown.is_set():
-            if time.monotonic() - start > deadline:
+            if deadline.expired:
+                _dl.count_deadline_exceeded("reconnect")
                 print(
                     f"ray_tpu worker {self.worker_id.hex()[:8]}: head did "
-                    f"not return within {deadline:.0f}s "
+                    f"not return within {budget:.0f}s "
                     "(head_reconnect_deadline_s); exiting",
                     file=sys.stderr, flush=True,
                 )
@@ -1201,8 +1279,9 @@ class Worker:
                     file=sys.stderr, flush=True,
                 )
                 self._exit_with_drain(0)
-            time.sleep(backoff * (0.5 + random.random()))
-            backoff = min(backoff * 2, 2.0)
+            attempt += 1
+            _dl.count_retry("reconnect")
+            policy.sleep(attempt, deadline)
         # Shutdown won the race: the run loop owns the exit path.
 
     def _exit_with_drain(self, code: int):

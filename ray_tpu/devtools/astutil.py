@@ -720,6 +720,15 @@ class ConcurrencyModel:
                 cb.has_caller = True
                 cb.entry_held = frozenset()
                 seeded = cb
+        elif tail in ("call_later", "call_at") and len(call.args) >= 2:
+            # loop.call_later(delay, cb, ...) / call_at(when, cb, ...):
+            # the callback runs on the same loop thread as call_soon.
+            cb = self._resolve_callable(call.args[1], func)
+            if cb is not None:
+                cb.role_seeds.add(ROLE_LOOP)
+                cb.has_caller = True
+                cb.entry_held = frozenset()
+                seeded = cb
         elif tail in ("on_push", "subscribe", "register", "handler") \
                 and len(call.args) >= 2:
             cb = self._resolve_callable(call.args[1], func)

@@ -60,6 +60,13 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """A call's per-call deadline budget (core/deadline.py) ran out before
+    any route — peer or head — produced a result.  Distinct from
+    GetTimeoutError: the CALL is abandoned (and its result sealed with
+    this error), not just one blocking get() giving up."""
+
+
 from .core.rpc import ConnectionLost as _ConnectionLost
 
 

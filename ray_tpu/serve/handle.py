@@ -46,24 +46,52 @@ class DeploymentResponse:
     update, crash) re-routes it once the routing table refreshes
     (reference: the router retries failed replicas)."""
 
-    def __init__(self, ref, done_cb=None, retry=None):
+    def __init__(self, ref, done_cb=None, retry=None,
+                 stall_timeout_s: Optional[float] = None, eject=None):
         self._ref = ref
         self._done_cb = done_cb
         self._retry = retry
+        # Gray-failure knob (handle.options(stall_timeout_s=...)): a
+        # replica holding the request past this many seconds is treated as
+        # stalled — ejected from the p2c set and the request re-routed,
+        # within the same REPLICA_RETRY_BUDGET that covers death.
+        self._stall_timeout_s = stall_timeout_s
+        self._eject = eject
 
     def result(self, timeout: float = 60.0):
-        from ..exceptions import ActorDiedError, WorkerCrashedError
+        from ..exceptions import (ActorDiedError, GetTimeoutError,
+                                  WorkerCrashedError)
 
+        deadline = time.monotonic() + timeout
         try:
             for attempt in range(REPLICA_RETRY_BUDGET):
+                last = attempt == REPLICA_RETRY_BUDGET - 1
+                get_timeout = timeout
+                if self._stall_timeout_s is not None:
+                    remaining = deadline - time.monotonic()
+                    get_timeout = min(self._stall_timeout_s,
+                                      max(0.0, remaining))
                 try:
-                    return ray_tpu.get(self._ref, timeout=timeout)
+                    return ray_tpu.get(self._ref, timeout=get_timeout)
                 except (ActorDiedError, WorkerCrashedError):
-                    if (self._retry is None
-                            or attempt == REPLICA_RETRY_BUDGET - 1):
+                    if self._retry is None or last:
                         raise
                     _count_replica_retry("unary")
                     time.sleep(0.2 * (attempt + 1))
+                    self._ref = self._retry()
+                except GetTimeoutError:
+                    # Stalled replica (accepts, never answers): eject it
+                    # from the p2c set and re-route — unless the stall
+                    # knob is off (then the timeout is the caller's own)
+                    # or the overall deadline is spent anyway.
+                    if (self._stall_timeout_s is None or self._retry is None
+                            or last
+                            or deadline - time.monotonic()
+                            <= self._stall_timeout_s):
+                        raise
+                    if self._eject is not None:
+                        self._eject()
+                    _count_replica_retry("stall")
                     self._ref = self._retry()
         finally:
             if self._done_cb is not None:
@@ -141,31 +169,42 @@ class DeploymentResponseGenerator:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method: str = "__call__",
-                 multiplexed_model_id: str = "", stream: bool = False):
+                 multiplexed_model_id: str = "", stream: bool = False,
+                 stall_timeout_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self.method = method
         self.multiplexed_model_id = multiplexed_model_id
         self.stream = stream
+        # Opt-in stalled-replica detection: a unary request unanswered for
+        # this long ejects its replica from the p2c set and re-routes
+        # (None = off; a replica can legitimately be slow).
+        self.stall_timeout_s = stall_timeout_s
         self._replicas: List[Any] = []
         self._replica_ids: List[int] = []
         self._version = -1
         self._last_refresh = 0.0
         self._local_load: Dict[int, int] = {}  # replica idx -> outstanding
+        self._ejected: Dict[int, float] = {}   # replica idx -> lift time
         self._lock = threading.Lock()
 
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                stall_timeout_s: Optional[float] = None
+                ) -> "DeploymentHandle":
         """(reference: serve/handle.py .options — method_name,
-        multiplexed_model_id and stream are the supported knobs here;
-        stream=True makes .remote() return a DeploymentResponseGenerator
-        over a generator deployment's items)."""
+        multiplexed_model_id, stream and stall_timeout_s are the supported
+        knobs here; stream=True makes .remote() return a
+        DeploymentResponseGenerator over a generator deployment's
+        items)."""
         return DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self.method,
             multiplexed_model_id if multiplexed_model_id is not None
             else self.multiplexed_model_id,
             stream if stream is not None else self.stream,
+            stall_timeout_s if stall_timeout_s is not None
+            else self.stall_timeout_s,
         )
 
     def _refresh(self, force: bool = False):
@@ -187,6 +226,9 @@ class DeploymentHandle:
                 )
                 self._version = table["version"]
                 self._local_load = {i: 0 for i in range(len(self._replicas))}
+                # Indexes shifted with the table: stale ejections would
+                # punish whichever replica inherited the slot.
+                self._ejected = {}
             self._last_refresh = now
 
     def _pick(self) -> int:
@@ -199,6 +241,10 @@ class DeploymentHandle:
         nearly every model on any scale event, stranding every warm
         cache)."""
         n = len(self._replicas)
+        now = time.monotonic()
+        if self._ejected:
+            for i in [i for i, lift in self._ejected.items() if now >= lift]:
+                self._ejected.pop(i, None)  # lift: the next pick re-probes
         if n == 1:
             return 0
         if self.multiplexed_model_id:
@@ -207,7 +253,16 @@ class DeploymentHandle:
             ids = self._replica_ids if len(self._replica_ids) == n \
                 else list(range(n))
             return pick_replica_for_model(self.multiplexed_model_id, ids)
-        i, j = random.sample(range(n), 2)
+        # Stalled replicas sit out of the candidate set until their lift
+        # time — unless everything is ejected, in which case degrading to
+        # the full set beats refusing the request.
+        avail = [i for i in range(n) if i not in self._ejected] \
+            if self._ejected else list(range(n))
+        if not avail:
+            avail = list(range(n))
+        if len(avail) == 1:
+            return avail[0]
+        i, j = random.sample(avail, 2)
         return i if self._local_load.get(i, 0) <= self._local_load.get(j, 0) \
             else j
 
@@ -302,9 +357,19 @@ class DeploymentHandle:
 
         if self.stream:
             return DeploymentResponseGenerator(ref, done, retry)
-        return DeploymentResponse(ref, done, retry)
+
+        def eject():
+            with self._lock:
+                lift = time.monotonic() + max(
+                    5.0, 2.0 * (self.stall_timeout_s or 0.0))
+                self._ejected[state["idx"]] = lift
+
+        return DeploymentResponse(ref, done, retry,
+                                  stall_timeout_s=self.stall_timeout_s,
+                                  eject=eject)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.method,
-                 self.multiplexed_model_id, self.stream))
+                 self.multiplexed_model_id, self.stream,
+                 self.stall_timeout_s))

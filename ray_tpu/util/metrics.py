@@ -79,6 +79,12 @@ BUILTIN_METRICS: Dict[str, str] = {
     "ray_tpu_head_restarts_total": "counter",
     "ray_tpu_headless_seconds": "gauge",
     "ray_tpu_resync_reports_total": "counter",
+    # network fault plane (util/netfault.py injection sites; core/deadline.py
+    # retry/deadline sites; core/dataplane.py quarantines)
+    "ray_tpu_netfaults_injected_total": "counter",
+    "ray_tpu_rpc_retries_total": "counter",
+    "ray_tpu_rpc_deadline_exceeded_total": "counter",
+    "ray_tpu_peer_quarantines_total": "counter",
     # logging plane (core/worker_main.py)
     "ray_tpu_logs_dropped_total": "counter",
     # tracing span plane (util/tracing.py): batched flushes + visible drops

@@ -9,11 +9,19 @@
 #   scripts/chaos_soak.sh [N]               # default N=5
 #   scripts/chaos_soak.sh --race-sentinel [N]
 #   scripts/chaos_soak.sh --head-kill [N]   # head SIGKILL+restart subset only
+#   scripts/chaos_soak.sh --netfault [N]    # network fault-injection subset
 #   CHAOS_PYTEST_ARGS="-k drain" scripts/chaos_soak.sh 10
 #
 # Rotating seeds: each iteration exports RT_CHAOS_SEED=<iter>, which the
 # chaos tests feed to their PreemptionInjector / victim RNGs, so every
 # pass kills a different node/worker mix.
+#
+# --netfault soaks the network chaos subset (tests/test_netfault.py):
+# seeded partitions, gray stalls, and dropped/duplicated frames via the
+# util/netfault FaultSchedule.  Each iteration rotates RT_NETFAULT_SEED;
+# on a failure the armed schedule lines ("netfault: armed seed=... spec=...")
+# are replayed from the log so the exact fault sequence reproduces with
+# RT_NETFAULT_SEED=<seed> alone.
 #
 # --race-sentinel (or RT_DEBUG_LOCKS=2 in the environment) soaks with the
 # devtools.locks runtime race sentinel armed in EVERY process: lock
@@ -33,6 +41,7 @@ while [ $# -gt 0 ]; do
     case "$1" in
         --race-sentinel) LOCKS_LEVEL=2; shift ;;
         --head-kill) MODE="head-kill"; shift ;;
+        --netfault) MODE="netfault"; shift ;;
         *) break ;;
     esac
 done
@@ -42,6 +51,9 @@ cd "$(dirname "$0")/.."
 if [ "$MODE" = "head-kill" ]; then
     TARGETS="tests/test_head_crash.py"
     MARK="chaos"
+elif [ "$MODE" = "netfault" ]; then
+    TARGETS="tests/test_netfault.py"
+    MARK="chaos"
 else
     TARGETS="tests/test_fault_tolerance.py tests/test_chaos.py tests/test_head_crash.py"
     MARK="chaos"
@@ -49,16 +61,23 @@ fi
 
 fails=0
 for i in $(seq 1 "$N"); do
-    echo "=== chaos soak iteration $i/$N (mode=$MODE RT_CHAOS_SEED=$i) ==="
+    echo "=== chaos soak iteration $i/$N (mode=$MODE seed=$i) ==="
+    LOG="$(mktemp /tmp/chaos_soak.XXXXXX.log)"
     if ! env JAX_PLATFORMS=cpu RT_CHAOS_SEED="$i" \
+        RT_NETFAULT_SEED="$i" \
         RT_DEBUG_LOCKS="$LOCKS_LEVEL" \
         timeout -k 10 600 python -m pytest -q \
         -m "$MARK" $TARGETS \
         -p no:cacheprovider -p no:randomly \
-        ${CHAOS_PYTEST_ARGS:-}; then
+        ${CHAOS_PYTEST_ARGS:-} 2>&1 | tee "$LOG"; then
         echo "!!! chaos soak FAILED on iteration $i (seed $i)"
+        if [ "$MODE" = "netfault" ]; then
+            echo "!!! failing fault schedules (replay with RT_NETFAULT_SEED=$i):"
+            grep -h "netfault: armed" "$LOG" | sort -u || true
+        fi
         fails=$((fails + 1))
     fi
+    rm -f "$LOG"
 done
 
 if [ "$fails" -gt 0 ]; then
