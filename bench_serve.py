@@ -254,6 +254,88 @@ def assert_trace_completeness(engine) -> Dict:
     return {"stages": sorted(by_name), "ttft_s": round(ttft_s, 6)}
 
 
+def assert_step_records(engine) -> Dict:
+    """Drive ONE request through the engine and assert the flight
+    recorder captured it: records exist for this engine, every record
+    carries the full field set, and at least one decode step shows the
+    admitted sequence occupying a slot.  A recorder regression (ring
+    stops filling, a field dropped, silent drops) fails the slow gate
+    here instead of surviving until a post-mortem needs the black box.
+    Raises SystemExit on failure."""
+    from ray_tpu.util import steprec
+
+    steprec.drain_buffered()  # isolate this request's records
+    dropped0 = steprec.dropped_total()
+    stream = engine.submit([3, 5, 7], max_new_tokens=4)
+    for _ in stream:
+        pass
+    # The final step's record lands AFTER its tokens are consumable:
+    # collect until a decoded record shows up (bounded).
+    recs: List[Dict] = []
+    deadline = time.perf_counter() + 2.0
+    while time.perf_counter() < deadline:
+        recs += [r for r in steprec.drain_buffered()
+                 if r.get("engine") == engine.engine_id]
+        if any(r.get("occupancy", 0) > 0 for r in recs):
+            break
+        time.sleep(0.05)
+    if not recs:
+        raise SystemExit(
+            "step-record check FAILED: no flight-recorder records for "
+            f"engine {engine.engine_id}")
+    required = {"t", "engine", "step", "wall_s", "stall_s", "occupancy",
+                "slots", "admitted", "evicted", "shed", "queued",
+                "pages_used", "pages_free", "pages_shared", "prefix_hits",
+                "adapter_pins", "tenants"}
+    for r in recs:
+        missing = required - set(r)
+        if missing:
+            raise SystemExit(
+                "step-record check FAILED: record missing fields "
+                f"{sorted(missing)}")
+    decoded = [r for r in recs if r["occupancy"] > 0]
+    if not decoded:
+        raise SystemExit(
+            "step-record check FAILED: no record shows the admitted "
+            "sequence occupying a slot")
+    if sum(r["admitted"] for r in recs) < 1:
+        raise SystemExit(
+            "step-record check FAILED: the admission never recorded")
+    if steprec.dropped_total() != dropped0:
+        raise SystemExit(
+            "step-record check FAILED: records dropped during an idle "
+            "single-request run")
+    return {"records": len(recs), "steps_decoded": len(decoded),
+            "admitted": int(sum(r["admitted"] for r in recs))}
+
+
+def run_recorder_overhead(n_requests: int, seed: int = 0) -> Dict:
+    """Recorder-on vs recorder-off decode throughput on identical
+    closed-loop traffic.  The recorder's contract is <= 2% step overhead
+    (one dict append per step; no device work); ``overhead_frac`` is the
+    tracked number.  The hard gate is deliberately loose (25%) — a
+    2-vCPU CI box cannot hold a 2% assertion without flaking, but a
+    blowup means the record path grew device syncs or lock contention
+    and must fail loudly."""
+    caps: Dict[str, Dict] = {}
+    for on in (True, False):
+        eng = _build_engine("continuous", seed=seed,
+                            engine_kw=dict(ENGINE_KW, step_record=on))
+        try:
+            caps["on" if on else "off"] = measure_capacity(
+                eng, n_requests, seed=seed)
+        finally:
+            eng.shutdown()
+    overhead = (caps["off"]["tokens_per_s"]
+                / max(caps["on"]["tokens_per_s"], 1e-9)) - 1.0
+    if overhead > 0.25:
+        raise SystemExit(
+            f"recorder-overhead row FAILED: flight recorder cost "
+            f"{overhead:.1%} of decode throughput (contract: ~2%)")
+    return {"recorder_on": caps["on"], "recorder_off": caps["off"],
+            "overhead_frac": round(max(0.0, overhead), 4)}
+
+
 def run_adapter_mix(n_requests: int, seed: int = 0) -> Dict:
     """Multi-LoRA traffic: requests rotate across the base model and six
     registered adapters (more adapters than device slots, so the pool
@@ -498,6 +580,10 @@ def main(argv=None) -> Dict:
             # already-built engine): propagation regressions fail the
             # bench, and therefore the slow CI gate, loudly.
             report["trace_check"] = assert_trace_completeness(eng)
+            # Flight-recorder gate: the same engine must have recorded
+            # the request step-by-step (observability regressions fail
+            # here, not in a post-mortem).
+            report["step_record_check"] = assert_step_records(eng)
         trials = [measure_capacity(eng, n_cap, seed=t) for t in range(2)]
         caps[mode] = max(t["tokens_per_s"] for t in trials)
         report["capacity"][mode] = {
@@ -536,6 +622,11 @@ def main(argv=None) -> Dict:
         "shared_prefix": run_shared_prefix(n_pfx),
     }
 
+    # Observability cost row: recorder-on vs recorder-off capacity on
+    # identical closed-loop traffic (contract: ~2% step overhead).
+    report["recorder_overhead"] = run_recorder_overhead(
+        16 if args.smoke else 32)
+
     def _at(mode, lvl):
         return next(r for r in report["modes"][mode]
                     if r["load_level"] == lvl)
@@ -555,6 +646,8 @@ def main(argv=None) -> Dict:
         "overload_goodput_ratio": round(
             c_over["tokens_per_s"] / max(c_sat["tokens_per_s"], 1e-9), 2),
         "overload_shed": c_over["shed"],
+        "recorder_overhead_frac":
+            report["recorder_overhead"]["overhead_frac"],
         "adapter_mix_tokens_per_s":
             report["multi_tenant"]["adapter_mix"]["tokens_per_s"],
         "prefix_cache_hit_rate":

@@ -231,9 +231,14 @@ class Client:
                 # batched span_batch entry — the existing background-report
                 # cadence IS the span flush cadence (and while headless the
                 # batch buffers for replay like task_done reports).
+                from ray_tpu.util import steprec as _steprec
                 from ray_tpu.util import tracing as _tracing
 
                 _tracing.flush_spans(self)
+                # Flight-recorder plane: engine step records batch-flush on
+                # the same cadence (and dump the black-box sidecar so a
+                # SIGKILL still leaves the last N steps on disk).
+                _steprec.flush_steps(self)
                 # Safety net: batched calls must not sit forever in a driver
                 # that stops making client calls (e.g. waits on side effects).
                 self._flush_submit_batch()

@@ -256,6 +256,23 @@ class Config:
     # background-report cadence; overflow drops (counted in
     # ray_tpu_spans_dropped_total), never blocks the emitting thread.
     span_ring_size: int = 4096
+    # Per-process bounded engine step-record ring (util/steprec.py): the
+    # serve engine's flight recorder appends one fixed-size record per
+    # decode step here; records flush as one batched engine_step_batch RPC
+    # on the background-report cadence.  Overflow drops (counted in
+    # ray_tpu_step_records_dropped_total), never blocks the decode loop.
+    step_ring_size: int = 2048
+    # Black-box sidecar: the last N step records are mirrored to a
+    # *.steps.log file next to the worker's log on every flush, so a
+    # SIGKILLed worker leaves its final steps on disk for
+    # `ray_tpu logs --post-mortem`.  0 disables the sidecar.
+    step_dump_records: int = 256
+    # Minimum seconds between sidecar rewrites (the dump is a whole-file
+    # rewrite of <= step_dump_records compact JSON lines).
+    step_dump_interval_s: float = 1.0
+    # Head-side retention: step records kept per engine for
+    # list_state(kind="engine_steps") / `ray_tpu top`.
+    engine_steps_max_records: int = 1024
     # Per-process metrics flusher cadence (util/metrics.py).  An atexit hook
     # ships the final window regardless, so short-lived workers don't lose
     # their last deltas.

@@ -292,6 +292,17 @@ class Head:
         # worker's stack_dump_reply.
         self._stack_waiters: Dict[int, asyncio.Future] = {}
         self._stack_token = 0
+        # In-flight profile round-trips (same token discipline; resolved
+        # by profile_reply after the worker's N-second capture).
+        self._profile_waiters: Dict[int, asyncio.Future] = {}
+        # Flight-recorder plane: per-engine bounded step-record rings fed
+        # by h_engine_step_batch; list_state("engine_steps") and
+        # `ray_tpu top` read them (engine id -> deque of records,
+        # oldest-engine evicted when the table itself fills).
+        self.engine_steps: "OrderedDict[str, deque]" = OrderedDict()
+        # Device-memory accounting: latest util/devmem snapshot per
+        # reporting worker pid, identity-joined at report time.
+        self.devmem_by_pid: Dict[int, dict] = {}
         # Named actors that could NOT be restored after a head restart
         # (constructor args lived in the dead session's object store):
         # name -> human-readable reason, surfaced by get_actor(name)
@@ -396,6 +407,7 @@ class Head:
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
             "node_health_ack", "node_stats", "node_drain", "span_batch",
             "get_log", "stack_dump", "stack_dump_reply",
+            "engine_step_batch", "devmem_report", "profile", "profile_reply",
             "resolve_actor", "lease_request", "lease_return", "lease_renew",
             "direct_done",
         ]:
@@ -2860,6 +2872,51 @@ class Head:
                 self.builtin_metrics.task_duration.observe(end - start)
         return {}
 
+    async def h_engine_step_batch(self, conn, body):
+        """Batched flight-recorder step records from inference engines
+        (util/steprec ring flush, riding the same coalesced-batch path as
+        span_batch/task_done).  Per-engine bounded rings: the head keeps
+        the recent window, the worker's black-box sidecar keeps the
+        crash-proof copy.  Malformed entries are skipped so one bad
+        record can't drop an engine's whole batch."""
+        cap = max(16, self.config.engine_steps_max_records)
+        for rec in body["steps"]:
+            if not isinstance(rec, dict) or not rec.get("engine") \
+                    or not isinstance(rec.get("step"), int):
+                continue
+            eid = str(rec["engine"])
+            ring = self.engine_steps.get(eid)
+            if ring is None:
+                # Bound the engine table itself (worker churn must not
+                # grow it forever): evict the least-recently-fed engine.
+                while len(self.engine_steps) >= 64:
+                    self.engine_steps.popitem(last=False)
+                ring = self.engine_steps[eid] = deque(maxlen=cap)
+            else:
+                self.engine_steps.move_to_end(eid)
+            ring.append(rec)
+        return {}
+
+    async def h_devmem_report(self, conn, body):
+        """Device-memory snapshot from a worker (util/devmem pools +
+        per-device stats + compile observability), identity-joined here
+        so list_state("devmem") / `ray_tpu top` can group by node."""
+        pid = int(body["pid"])
+        worker_id = self.conn_to_worker.get(conn.conn_id)
+        w = self.workers.get(worker_id) if worker_id else None
+        self.devmem_by_pid[pid] = {
+            "pid": pid,
+            "worker_id": worker_id.hex() if worker_id else None,
+            "node_id": w.node_id.hex() if w is not None else None,
+            "devmem": body["devmem"],
+            "time": time.time(),
+        }
+        while len(self.devmem_by_pid) > 256:
+            oldest = min(self.devmem_by_pid,
+                         key=lambda p: self.devmem_by_pid[p]["time"])
+            del self.devmem_by_pid[oldest]
+        return {}
+
     async def h_node_stats(self, conn, body):
         node_id = NodeID(body["node_id"])
         self.node_stats[node_id] = {
@@ -3828,13 +3885,12 @@ class Head:
                          ("proc_id", "kind", "node_id", "pid", "actor_id")}
         return reply
 
-    async def h_stack_dump(self, conn, body):
-        """All-thread Python stacks from a live worker, on demand and
-        without interrupting the running task (the worker collects them on
-        its rpc thread) — the hung-gang diagnosis tool (`ray_tpu stack`)."""
-        query = str(body["worker_id"])
-        # Prefix resolution requires UNIQUENESS: during an incident, dumping
-        # an arbitrary first match would silently debug the wrong process.
+    def _resolve_live_worker(self, query: str):
+        """Resolve a worker by id hex prefix (or by hosting-actor id
+        prefix) for the introspection round trips (stack dump, profile).
+        Prefix resolution requires UNIQUENESS: during an incident,
+        picking an arbitrary first match would silently debug the wrong
+        process.  Returns (worker, None) or (None, error_reply)."""
         matches = [w for wid, w in self.workers.items()
                    if wid.hex() == query or wid.hex().startswith(query)]
         if not matches:
@@ -3846,14 +3902,23 @@ class Head:
                 and (aid.hex() == query or aid.hex().startswith(query))
             ]
         if len(matches) > 1:
-            return {"found": False,
-                    "error": f"{query!r} is ambiguous: matches "
-                             f"{len(matches)} workers — use a longer "
-                             "prefix (see `list workers`)"}
+            return None, {"found": False,
+                          "error": f"{query!r} is ambiguous: matches "
+                                   f"{len(matches)} workers — use a longer "
+                                   "prefix (see `list workers`)"}
         worker = matches[0] if matches else None
         if worker is None or not worker.conn.alive:
-            return {"found": False,
-                    "error": f"no live worker matches {query!r}"}
+            return None, {"found": False,
+                          "error": f"no live worker matches {query!r}"}
+        return worker, None
+
+    async def h_stack_dump(self, conn, body):
+        """All-thread Python stacks from a live worker, on demand and
+        without interrupting the running task (the worker collects them on
+        its rpc thread) — the hung-gang diagnosis tool (`ray_tpu stack`)."""
+        worker, err = self._resolve_live_worker(str(body["worker_id"]))
+        if worker is None:
+            return err
         self._stack_token += 1
         token = self._stack_token
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -3885,6 +3950,57 @@ class Head:
 
     async def h_stack_dump_reply(self, conn, body):
         fut = self._stack_waiters.get(body.get("token"))
+        if fut is not None and not fut.done():
+            fut.set_result(body)
+        return {}
+
+    async def h_profile(self, conn, body):
+        """On-demand device-trace capture on a live worker (`ray_tpu
+        profile`): a stack_dump-shaped token round trip, except the
+        worker sleeps through an N-second jax.profiler capture before
+        replying with the TensorBoard trace dir — so the wait deadline
+        scales with the requested capture length."""
+        worker, err = self._resolve_live_worker(str(body["worker_id"]))
+        if worker is None:
+            return err
+        seconds = float(body["seconds"])
+        self._stack_token += 1
+        token = self._stack_token
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._profile_waiters[token] = fut
+        push = {"token": token, "seconds": seconds}
+        if body.get("logdir"):
+            push["logdir"] = str(body["logdir"])
+        try:
+            await worker.conn.push("profile", push)
+            reply = await asyncio.wait_for(
+                fut, timeout=float(body.get("timeout", seconds + 30.0))
+            )
+        except asyncio.TimeoutError:
+            return {"found": True, "ok": False,
+                    "worker_id": worker.worker_id.hex(),
+                    "error": f"worker did not finish the {seconds:.0f}s "
+                             "capture in time (profiler wedged? check the "
+                             "worker log)"}
+        except Exception as e:
+            return {"found": True, "ok": False,
+                    "worker_id": worker.worker_id.hex(), "error": str(e)}
+        finally:
+            self._profile_waiters.pop(token, None)
+        out = {
+            "found": True, "ok": "error" not in reply,
+            "worker_id": worker.worker_id.hex(),
+            "node_id": worker.node_id.hex(),
+            "pid": reply.get("pid", worker.pid),
+        }
+        if reply.get("logdir"):
+            out["logdir"] = reply["logdir"]
+        if reply.get("error"):
+            out["error"] = reply["error"]
+        return out
+
+    async def h_profile_reply(self, conn, body):
+        fut = self._profile_waiters.get(body.get("token"))
         if fut is not None and not fut.done():
             fut.set_result(body)
         return {}
@@ -4029,6 +4145,28 @@ class Head:
         if kind == "metrics_history":
             return {"items": self.metrics_history.snapshot(
                 body.get("name_prefix", ""))}
+        if kind == "engine_steps":
+            # Flight-recorder view: one row per engine with its latest
+            # step record plus the retained window (optionally trimmed by
+            # ``limit`` and filtered by an ``engine`` id prefix).
+            engine = body.get("engine")
+            limit = int(body.get("limit") or 0)
+            items = []
+            for eid, ring in self.engine_steps.items():
+                if engine and not eid.startswith(str(engine)):
+                    continue
+                recs = list(ring)
+                if limit > 0:
+                    recs = recs[-limit:]
+                items.append({
+                    "engine": eid,
+                    "latest": recs[-1] if recs else None,
+                    "records": recs,
+                })
+            return {"items": items}
+        if kind == "devmem":
+            return {"items": sorted(
+                self.devmem_by_pid.values(), key=lambda r: r["pid"])}
         raise ValueError(f"unknown state kind {kind!r}")
 
     async def h_shutdown_cluster(self, conn, body):

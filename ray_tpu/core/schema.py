@@ -93,6 +93,16 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "get_log": (("proc_id", str),),
     "stack_dump": (("worker_id", str),),
     "stack_dump_reply": (("token", _NUM), ("dump", str)),
+    # Flight recorder: batched engine step records (each entry needs
+    # engine/step; the handler skips malformed entries like span_batch).
+    "engine_step_batch": (("steps", list),),
+    # Device-memory accounting snapshot (util/devmem.py), shipped on the
+    # worker's metrics cadence.
+    "devmem_report": (("pid", _NUM), ("devmem", dict)),
+    # On-demand profiler capture (stack_dump-shaped token round trip:
+    # CLI -> head -> worker push -> profile_reply resolves the waiter).
+    "profile": (("worker_id", str), ("seconds", _NUM)),
+    "profile_reply": (("token", _NUM),),
     # -- dataplane: peer-to-peer calls + node-local task leases ---------------
     # resolve_actor is a pure read (idempotent) but keeps a row so the
     # address-resolution wire shape is owned here like every other method.

@@ -319,6 +319,10 @@ class Worker:
         # collective; reference: `ray stack` attaches py-spy, here the
         # worker cooperates via sys._current_frames).
         self.client.rpc.on_push("stack_dump", self._on_stack_dump)
+        # On-demand profiler capture (`ray_tpu profile`): same token round
+        # trip as stack_dump, but the capture sleeps for N seconds — it
+        # runs on a fresh thread so the rpc loop keeps serving pushes.
+        self.client.rpc.on_push("profile", self._on_profile)
         # Headless degraded mode: a lost head connection starts a reconnect
         # loop instead of killing the process — in-flight tasks, direct
         # peer calls, and peer streaming keep executing; completion reports
@@ -333,6 +337,11 @@ class Worker:
         if os.environ.get("RT_LOG_TO_DRIVER", "1") != "0":
             sys.stdout = _LogTee(sys.stdout, self.client, "stdout")
             sys.stderr = _LogTee(sys.stderr, self.client, "stderr")
+        # Device-memory accounting: ship a util/devmem snapshot on the
+        # metrics cadence.  maybe_snapshot() returns None until jax is
+        # actually imported, so CPU-only task workers pay nothing.
+        threading.Thread(target=self._devmem_loop, daemon=True,
+                         name="devmem-report").start()
         # Handshake: only now may the head lease us (push handlers installed).
         self.client.call("worker_ready", {})
 
@@ -1294,9 +1303,15 @@ class Worker:
                     stream.flush_residual()
             # Trailing spans (the final task's execution span lands in the
             # ring AFTER its task_done) must not die with the process.
+            from ..util import steprec as _steprec
             from ..util import tracing as _tracing
 
             _tracing.flush_spans(self.client)
+            # Flight recorder: final step batch + a forced black-box dump
+            # (the sidecar next to the log file is what post-mortem tools
+            # read when the head never saw these records).
+            _steprec.flush_steps(self.client)
+            _steprec.dump_black_box(force=True)
             self.client._flush_submit_batch()
             from ray_tpu.util.metrics import _final_flush
 
@@ -1338,6 +1353,66 @@ class Worker:
             })
         except Exception:
             pass
+
+    def _on_profile(self, body):
+        """On-demand profiler capture (head push, stack_dump-shaped token
+        round trip): run util.profiling.device_trace around the live
+        process for N seconds, then reply with the TensorBoard trace dir.
+        The capture sleeps, so it MUST leave the rpc loop thread — a
+        second concurrent request fails typed (ProfilerBusyError) rather
+        than wedging the first."""
+        def capture():
+            token = body.get("token", 0)
+            seconds = float(body.get("seconds", 3.0))
+            logdir = body.get("logdir") or os.path.join(
+                "/tmp/ray_tpu_profiles",
+                f"worker-{self.worker_id.hex()[:8]}-{os.getpid()}")
+            reply: Dict[str, Any] = {"token": token, "pid": os.getpid()}
+            try:
+                from ..util import profiling as _profiling
+
+                with _profiling.device_trace(logdir):
+                    time.sleep(max(0.05, seconds))
+                reply["logdir"] = logdir
+                try:
+                    from ray_tpu.util.metrics import get_counter
+
+                    get_counter(
+                        "ray_tpu_profile_captures_total",
+                        "completed on-demand device-trace captures",
+                    ).inc()
+                except Exception:
+                    pass
+            except Exception as e:
+                reply["error"] = f"{type(e).__name__}: {e}"
+            try:
+                self.client.rpc.call_async("profile_reply", reply)
+            except Exception:
+                pass
+
+        threading.Thread(target=capture, daemon=True,
+                         name="profile-capture").start()
+
+    def _devmem_loop(self):
+        """Periodic device-memory report (util/devmem snapshot → head),
+        joined into node snapshots and served by ``list_state("devmem")``
+        / ``ray_tpu top``.  Headless windows just skip reports (the
+        snapshot is cheap to retake; stale ones aren't worth replaying)."""
+        from ..util import devmem as _devmem
+
+        while not self._shutdown.is_set():
+            interval = max(1.0, get_config().metrics_flush_interval_s)
+            self._shutdown.wait(interval)
+            if self._shutdown.is_set() or self.client.rpc.closed:
+                continue
+            try:
+                snap = _devmem.maybe_snapshot()
+                if snap is not None:
+                    self.client.call_bg(
+                        "devmem_report",
+                        {"pid": os.getpid(), "devmem": snap})
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ cancellation
 

@@ -47,6 +47,13 @@ def trace_count(name: str) -> int:
     return _TRACE_COUNTS.get(name, 0)
 
 
+def trace_counts() -> Dict[str, int]:
+    """Snapshot of every program's trace count (devmem/compile
+    observability: a nonzero delta between snapshots means XLA compiled
+    a new specialization in that window)."""
+    return dict(_TRACE_COUNTS)
+
+
 def _bump(name: str) -> None:
     _TRACE_COUNTS[name] = _TRACE_COUNTS.get(name, 0) + 1
 

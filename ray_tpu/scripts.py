@@ -37,17 +37,22 @@ def _client(addr: Optional[str]):
     return Client(_resolve_address(addr), kind="driver", pid=os.getpid())
 
 
-def _print_table(rows, columns, empty: str = "(no items)"):
+def _format_table(rows, columns, empty: str = "(no items)") -> str:
     if not rows:
-        print(empty)
-        return
+        return empty
     widths = {
         c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
         for c in columns
     }
-    print("  ".join(c.upper().ljust(widths[c]) for c in columns))
+    out = ["  ".join(c.upper().ljust(widths[c]) for c in columns)]
     for r in rows:
-        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
+        out.append(
+            "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
+    return "\n".join(out)
+
+
+def _print_table(rows, columns, empty: str = "(no items)"):
+    print(_format_table(rows, columns, empty))
 
 
 def _union_columns(items) -> list:
@@ -130,9 +135,138 @@ def cmd_status(args) -> int:
                     print(f"  node {node}: {secs:.1f}s headless")
         except Exception:
             pass  # older head without the FT metrics: stay quiet
+        # Inference engines (flight-recorder + devmem planes): one line
+        # per engine with batch occupancy, KV pages, adapter pins, and
+        # device bytes by pool.
+        try:
+            engines = cl.call(
+                "list_state", {"kind": "engine_steps", "limit": 64}
+            )["items"]
+            devmem = cl.call("list_state", {"kind": "devmem"})["items"]
+            for row in _engine_rows(engines, devmem):
+                print(f"  engine {row['engine']}: slots {row['slots']}  "
+                      f"queued {row['queued']}  pages {row['pages']}  "
+                      f"stall {row['stall%']}%  "
+                      f"adapters pinned {row['adapters']}"
+                      + (f"  hbm {row['hbm']}" if row["hbm"] else ""))
+        except Exception:
+            pass  # older head without the observability plane: stay quiet
     finally:
         cl.close()
     return 0
+
+
+def _engine_rows(engines, devmem_items) -> list:
+    """Join engine flight-recorder windows with devmem pool snapshots into
+    display rows (shared by `status` and `top`).  Engine ids are
+    ``<pid>.<seq>``, so the pid prefix keys into the devmem reports."""
+    dm_by_pid = {d.get("pid"): (d.get("devmem") or {}) for d in devmem_items}
+    rows = []
+    for e in engines:
+        recs = e.get("records") or []
+        latest = e.get("latest") or {}
+        wall = sum(float(r.get("wall_s") or 0) for r in recs)
+        stall = sum(float(r.get("stall_s") or 0) for r in recs)
+        try:
+            pid = int(str(e.get("engine", "")).split(".", 1)[0])
+        except ValueError:
+            pid = None
+        pools = dm_by_pid.get(pid, {}).get("pools") or {}
+        tenants = latest.get("tenants") or {}
+        rows.append({
+            "engine": e.get("engine", "?"),
+            "slots": f"{latest.get('occupancy', 0)}/"
+                     f"{latest.get('slots', 0)}",
+            "queued": latest.get("queued", 0),
+            "stall%": f"{100.0 * stall / wall:.1f}" if wall > 0 else "0.0",
+            "pages": f"{latest.get('pages_used', 0)}u/"
+                     f"{latest.get('pages_free', 0)}f",
+            "adapters": latest.get("adapter_pins", 0),
+            "hbm": " ".join(
+                f"{name}={nbytes / 2**20:.0f}M"
+                for name, nbytes in sorted(pools.items()) if nbytes
+            ),
+            "tenants": " ".join(
+                f"{t}:{n}" for t, n in sorted(tenants.items())) or "-",
+        })
+    return rows
+
+
+def _node_row(n: dict) -> dict:
+    stats = n.get("stats") or {}
+    mem = stats.get("mem_used_frac")
+    return {
+        "node": n.get("node_id", "")[:8],
+        "alive": n.get("alive"),
+        "load1": stats.get("load1", ""),
+        "mem%": round(100 * mem, 1) if isinstance(mem, (int, float)) else "",
+        "procs": stats.get("num_worker_procs", ""),
+        "cpu": "{:g}/{:g}".format(
+            (n.get("available") or {}).get("CPU", 0),
+            (n.get("resources") or {}).get("CPU", 0)),
+    }
+
+
+def _render_top(cl) -> str:
+    """One frame of `ray_tpu top`: cluster header, node table, and the
+    per-engine occupancy/stall/pages/HBM table."""
+    import time as _time
+
+    nodes = cl.call("list_state", {"kind": "nodes"})["items"]
+    workers = cl.call("list_state", {"kind": "workers"})["items"]
+    engines = cl.call(
+        "list_state", {"kind": "engine_steps", "limit": 64})["items"]
+    devmem = cl.call("list_state", {"kind": "devmem"})["items"]
+    alive = sum(1 for n in nodes if n.get("alive"))
+    sections = [
+        f"ray_tpu top  {_time.strftime('%H:%M:%S')}  "
+        f"nodes {alive}/{len(nodes)} alive  workers {len(workers)}",
+        "",
+        _format_table(
+            [_node_row(n) for n in nodes],
+            ["node", "alive", "load1", "mem%", "procs", "cpu"],
+            empty="(no nodes)",
+        ),
+        "",
+        _format_table(
+            _engine_rows(engines, devmem),
+            ["engine", "slots", "queued", "stall%", "pages", "adapters",
+             "hbm", "tenants"],
+            empty="(no engines reporting — flight recorder off or no "
+                  "serve traffic yet)",
+        ),
+    ]
+    return "\n".join(sections)
+
+
+def cmd_top(args) -> int:
+    """Auto-refreshing cluster table (reference: `ray status -v` + the
+    dashboard, as a terminal loop): nodes, workers, and per-engine
+    occupancy/stall%/KV pages/HBM-by-pool from the flight-recorder and
+    devmem planes.  --once renders a single frame (scripts/CI)."""
+    cl = _client(args.address)
+    try:
+        while True:
+            try:
+                frame = _render_top(cl)
+            except KeyboardInterrupt:
+                return 0
+            except Exception as e:
+                frame = f"(top refresh failed: {e})"
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(frame)
+            sys.stdout.flush()
+            if args.once:
+                return 0
+            try:
+                import time as _time
+
+                _time.sleep(max(0.2, args.interval))
+            except KeyboardInterrupt:
+                return 0
+    finally:
+        cl.close()
 
 
 def cmd_down(args) -> int:
@@ -325,6 +459,16 @@ def _post_mortem_tails(args) -> int:
             glob.glob(os.path.join(LOG_ROOT, "*", "*.log")),
             key=lambda p: os.path.getmtime(p) if os.path.exists(p) else 0,
         )
+    # Flight-recorder black boxes (<log>.steps.log sidecars) ride along
+    # with their log's tail: the head's index stores only the log file
+    # itself, and the SIGKILLed worker the sidecar exists for is exactly
+    # the one a post-mortem is after.
+    for path in list(paths):
+        stem = path[:-4] if path.endswith(".log") else path
+        sidecar = stem + ".steps.log"
+        if sidecar != path and sidecar not in paths \
+                and os.path.exists(sidecar):
+            paths.append(sidecar)
     shown = 0
     for path in paths[-40:]:
         try:
@@ -420,6 +564,30 @@ def cmd_stack(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """On-demand device-trace capture of a live worker (reference:
+    `ray timeline`-class tooling; here the profiler of record is
+    jax.profiler): the worker wraps its live process in
+    util.profiling.device_trace for N seconds and replies with the
+    TensorBoard trace dir."""
+    cl = _client(args.address)
+    try:
+        body = {"worker_id": args.worker_id, "seconds": args.seconds}
+        if args.logdir:
+            body["logdir"] = args.logdir
+        reply = cl.call("profile", body, timeout=args.seconds + 60)
+    finally:
+        cl.close()
+    if not reply.get("found") or not reply.get("ok"):
+        print(reply.get("error", "profile capture failed"), file=sys.stderr)
+        return 1
+    print(f"worker {reply['worker_id'][:16]} pid={reply.get('pid')} "
+          f"node={reply.get('node_id', '')[:8]}")
+    print(f"trace dir: {reply.get('logdir')}")
+    print(f"view with: tensorboard --logdir {reply.get('logdir')}")
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Declarative Serve operations (reference: `serve deploy/status/
     shutdown` CLI over the schema config)."""
@@ -473,6 +641,7 @@ def main(argv=None) -> int:
     p.add_argument("kind", choices=[
         "actors", "tasks", "nodes", "workers", "objects",
         "placement_groups", "pgs", "logs", "task_events",
+        "engine_steps", "devmem",
     ])
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_list)
@@ -512,6 +681,28 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("status", help="cluster resource summary")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser(
+        "top", help="auto-refreshing cluster/engine table"
+    )
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (scripts/CI)")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "profile",
+        help="capture a device trace (jax.profiler) on a live worker",
+    )
+    p.add_argument("worker_id",
+                   help="worker id (hex prefix) or actor id")
+    p.add_argument("--seconds", type=float, default=3.0,
+                   help="capture window length")
+    p.add_argument("--logdir", default=None,
+                   help="trace destination on the worker's machine "
+                        "(default: /tmp/ray_tpu_profiles/<worker>)")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("down", help="shut the cluster down")
     p.set_defaults(fn=cmd_down)

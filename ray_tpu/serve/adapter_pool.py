@@ -196,6 +196,12 @@ class AdapterPool:
             source = pack_lora(self.model_config, source)
         return source
 
+    @property
+    def pinned_count(self) -> int:
+        """Adapters currently pinned by in-flight sequences (cheap: read
+        on the engine's step-record path every decode step)."""
+        return sum(1 for c in self._pins.values() if c)
+
     def stats(self) -> Dict[str, Any]:
         return {
             "registered": len(self._sources),

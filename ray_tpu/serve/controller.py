@@ -23,19 +23,27 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 def _scale_decision(cur: int, min_r: int, max_r: int,
                     per_queue: float, target_q: float,
                     ttft_p90: Optional[float] = None,
-                    target_ttft: Optional[float] = None) -> int:
+                    target_ttft: Optional[float] = None,
+                    stall_frac: Optional[float] = None,
+                    target_stall_frac: float = 0.25) -> int:
     """Pure scaling decision (unit-testable without actors): breach of
-    EITHER signal scales up; scale-down needs BOTH comfortably idle.
+    ANY signal scales up; scale-down needs ALL comfortably idle.
     TTFT is the user-facing SLO — queue depth alone under-scales an
     engine whose batch is full but whose queue drains slowly (every
     admitted sequence decodes for many steps, so a short queue can still
-    mean seconds of time-to-first-token)."""
+    mean seconds of time-to-first-token).  ``stall_frac`` is the engine's
+    admission-stall pressure (InferenceEngine.slo_signals, fraction of
+    the window the decode loop spent stalled on prefills): a saturated
+    engine stalls BEFORE TTFT breaches, so reacting to it scales ahead
+    of the user-visible miss."""
     breach = per_queue > target_q or (
         target_ttft is not None and ttft_p90 is not None
-        and ttft_p90 > target_ttft)
+        and ttft_p90 > target_ttft) or (
+        stall_frac is not None and stall_frac > target_stall_frac)
     idle = per_queue < target_q / 2 and (
         target_ttft is None or ttft_p90 is None
-        or ttft_p90 < target_ttft / 2)
+        or ttft_p90 < target_ttft / 2) and (
+        stall_frac is None or stall_frac < target_stall_frac / 2)
     if breach and cur < max_r:
         return cur + 1
     if idle and not breach and cur > min_r:
@@ -250,6 +258,7 @@ class ServeController:
         # signal call that fails) fall back to the queue-length probe.
         total_q = 0.0
         ttfts: List[float] = []
+        stalls: List[float] = []
         target_ttft = auto.get("target_ttft_s")
         for r in reps:
             sig = None
@@ -265,6 +274,8 @@ class ServeController:
                 total_q += sig.get("queue_depth", 0)
                 if sig.get("ttft_p90_s") is not None:
                     ttfts.append(sig["ttft_p90_s"])
+                if sig.get("stall_frac") is not None:
+                    stalls.append(sig["stall_frac"])
                 continue
             try:
                 total_q += ray_tpu.get(r["handle"].queue_len.remote(),
@@ -276,7 +287,9 @@ class ServeController:
         cur = spec.get("_autoscaled", auto["min_replicas"])
         cur = _scale_decision(
             cur, auto["min_replicas"], auto["max_replicas"], per, target,
-            max(ttfts) if ttfts else None, target_ttft)
+            max(ttfts) if ttfts else None, target_ttft,
+            max(stalls) if stalls else None,
+            auto.get("target_stall_frac", 0.25))
         spec["_autoscaled"] = cur
         with self._lock:
             if name in self.targets:

@@ -31,6 +31,7 @@ batch is EMPTY (gang admission, drain to completion) — the baseline
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import math
 import queue as _queue
 import threading
@@ -38,6 +39,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+#: Engine identity within one process: step records carry
+#: ``"<pid>.<seq>"`` so the head's per-engine rings stay distinct when a
+#: process hosts several engines (bench harnesses, tests).
+_ENGINE_SEQ = itertools.count()
 
 
 class EngineOverloadedError(Exception):
@@ -75,6 +81,14 @@ class EngineConfig:
     lora_rank: int = 8
     prefix_cache: bool = True
     ttft_window: int = 64
+    # Flight recorder (util/steprec.py): one fixed-size record per decode
+    # step into the bounded per-process ring.  Off-hot-path by design
+    # (host counters only, no device sync); the bench_serve overhead row
+    # holds it to <= 2% of step wall.  step_window sizes the recent
+    # step-wall / stall deques feeding slo_signals jitter + stall
+    # pressure.
+    step_record: bool = True
+    step_window: int = 256
 
     @property
     def pages_per_seq(self) -> int:
@@ -318,6 +332,9 @@ class InferenceEngine:
             "ray_tpu_serve_tenant_shed_total",
             "Requests shed by weighted-fair admission, by tenant",
             tag_keys=("tenant",))
+        self._m_stall = get_counter(
+            "ray_tpu_engine_stall_seconds_total",
+            "Decode-loop seconds spent stalled on admission prefills")
         # Recent TTFTs feeding the controller's SLO autoscaling signal.
         import collections
 
@@ -325,6 +342,28 @@ class InferenceEngine:
         import os
 
         self._pid_tags = {"pid": str(os.getpid())}
+        # Flight recorder: engine identity + per-step deltas and the
+        # recent step-wall / stall windows behind slo_signals jitter.
+        self.engine_id = f"{os.getpid()}.{next(_ENGINE_SEQ)}"
+        self._step_walls = collections.deque(maxlen=max(16, cfg.step_window))
+        self._stall_events = collections.deque(
+            maxlen=max(16, cfg.step_window))  # (wall_time, stall_s)
+        self._pc_hits_total = 0
+        self._evicted_total = 0
+        # Device-memory attribution: the engine owns the big allocations,
+        # so it names them for util/devmem snapshots.  Weights bytes are
+        # static; pool/adapter lambdas chase the live arrays (donation
+        # replaces them every step).
+        from ..util import devmem
+
+        self._weights_bytes = sum(
+            int(getattr(x, "nbytes", 0))
+            for x in jax.tree_util.tree_leaves(params))
+        devmem.register_pool("model_weights", lambda: self._weights_bytes)
+        devmem.register_pool("kv_pool", lambda: sum(
+            int(a.nbytes) for a in self.pools.values()))
+        devmem.register_pool("adapter_pool", lambda: sum(
+            int(a.nbytes) for a in self.adapter_pool.arrays.values()))
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="llm-engine")
         self._thread.start()
@@ -450,6 +489,11 @@ class InferenceEngine:
             self._stop = True
             self._wake.notify()
         self._thread.join(timeout=10)
+        from ..util import devmem, steprec
+
+        for name in ("model_weights", "kv_pool", "adapter_pool"):
+            devmem.unregister_pool(name)
+        steprec.dump_black_box(force=True)  # graceful exits get a fresh box
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -481,27 +525,50 @@ class InferenceEngine:
             "adapters": self.adapter_pool.stats(),
         }
 
+    #: Window over which admission-stall seconds are summed for the
+    #: autoscaler's stall-pressure signal.
+    STALL_WINDOW_S = 30.0
+
     def slo_signals(self) -> Dict[str, Any]:
         """Queue-depth / TTFT snapshot for the controller's SLO-driven
-        autoscaling (cheap: host counters plus a tiny sort)."""
+        autoscaling (cheap: host counters plus a tiny sort), extended
+        with the step ring's stall and jitter signals: seconds the decode
+        loop spent stalled on admission prefills inside the last
+        ``STALL_WINDOW_S``, and decode-step p99 jitter (p99 - p50 step
+        wall).  The autoscaler reacts to stall pressure even while TTFT
+        still holds — a saturated engine stalls before it breaches."""
         ttfts = sorted(self._ttft_recent)
 
-        def pct(p: float) -> float:
-            if not ttfts:
+        def pct(vals: List[float], p: float) -> float:
+            if not vals:
                 return 0.0
-            return ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))]
+            return vals[min(len(vals) - 1, int(p * len(vals)))]
 
         with self._lock:
             queued = self._queued_total()
+            tenant_queues = {t: len(q)
+                             for t, q in self._queues.items() if q}
+        now = time.time()
+        stall_s = sum(s for (t, s) in list(self._stall_events)
+                      if now - t <= self.STALL_WINDOW_S)
+        walls = sorted(self._step_walls)
+        p50, p99 = pct(walls, 0.50), pct(walls, 0.99)
         return {
             "queue_depth": queued,
             "active_seqs": sum(1 for s in self.slots if s is not None),
             "batch_slots": self.config.batch_slots,
-            "ttft_p50_s": pct(0.50),
-            "ttft_p90_s": pct(0.90),
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p90_s": pct(ttfts, 0.90),
             "ttft_count": len(ttfts),
             "completed": self.completed,
             "shed": self.shed,
+            "stall_s_window": stall_s,
+            "stall_window_s": self.STALL_WINDOW_S,
+            "stall_frac": min(1.0, stall_s / self.STALL_WINDOW_S),
+            "step_p50_s": p50,
+            "step_p99_s": p99,
+            "step_jitter_p99_s": max(0.0, p99 - p50),
+            "tenant_queues": tenant_queues,
         }
 
     def _run_on_loop(self, fn, timeout: float = 30.0):
@@ -698,6 +765,7 @@ class InferenceEngine:
             self.adapter_pool.release(req.adapter)
             req.adapter_slot = -1
         req.finished = True
+        self._evicted_total += 1
         self.slots[slot] = None
         self._page_tables[slot, :] = self.scratch
         self._seq_lens[slot] = 0
@@ -771,6 +839,7 @@ class InferenceEngine:
                 aid, jnp.asarray(req.temperature, jnp.float32),
                 self._d_key)
             self._m_pc_hits.inc(1)
+            self._pc_hits_total += 1
             self._m_prefill.inc(suffix.size)  # only the work actually done
         else:
             s_pad = self._bucket_len(n)
@@ -941,11 +1010,26 @@ class InferenceEngine:
     def _run_step(self, admitted: List[_Request]) -> None:
         import jax.numpy as jnp
 
-        from ..models.paged import paged_decode_step
+        from ..models.paged import paged_decode_step, trace_counts
 
+        # Flight recorder entry state: step wall, admission-stall span,
+        # and per-step deltas come from host counters only — no device
+        # sync, no lock beyond what the loop already holds.
+        rec_on = self.config.step_record
+        t0 = time.perf_counter()
+        stall_s = 0.0
+        evicted0 = self._evicted_total
+        shed0 = self.shed
+        pc_hits0 = self._pc_hits_total
+        traces0 = trace_counts() if rec_on else None
         for req in admitted:
+            pf0 = time.perf_counter()
             self._prefill(req)
+            stall_s += time.perf_counter() - pf0
         if not any(s is not None for s in self.slots):
+            if rec_on and admitted:
+                self._record_step(t0, stall_s, len(admitted), evicted0,
+                                  shed0, pc_hits0, traces0, decoded=False)
             return
         self.step_count += 1
         if self._dirty:
@@ -985,6 +1069,56 @@ class InferenceEngine:
             tags=self._pid_tags)
         self._m_pages.set(self.allocator.used_count,
                           tags=self._pid_tags)
+        if rec_on:
+            self._record_step(t0, stall_s, len(admitted), evicted0,
+                              shed0, pc_hits0, traces0, decoded=True)
+
+    def _record_step(self, t0: float, stall_s: float, admitted: int,
+                     evicted0: int, shed0: int, pc_hits0: int,
+                     traces0: Optional[Dict[str, int]],
+                     decoded: bool) -> None:
+        """Append one flight-recorder record for the step that just ran.
+        Called on the loop thread; everything here is host bookkeeping
+        (the decode result was already synced for token emission)."""
+        from ..models.paged import trace_counts
+        from ..util import devmem, steprec
+
+        wall_s = time.perf_counter() - t0
+        now = time.time()
+        if decoded:
+            self._step_walls.append(wall_s)
+        if stall_s > 0:
+            self._stall_events.append((now, stall_s))
+            self._m_stall.inc(stall_s)
+        # Compile observability: a trace-count bump inside this step means
+        # this step's wall paid the compile — attribute it by program.
+        if traces0 is not None:
+            traces1 = trace_counts()
+            for prog, n in traces1.items():
+                if n > traces0.get(prog, 0):
+                    devmem.record_compile(prog, wall_s)
+        with self._lock:
+            queued = self._queued_total()
+            tenants = {t: len(q) for t, q in self._queues.items() if q}
+        steprec.record_step({
+            "t": round(now, 3),
+            "engine": self.engine_id,
+            "step": self.step_count,
+            "wall_s": round(wall_s, 6),
+            "stall_s": round(stall_s, 6),
+            "occupancy": sum(1 for s in self.slots if s is not None),
+            "slots": self.config.batch_slots,
+            "admitted": admitted,
+            "evicted": self._evicted_total - evicted0,
+            "shed": self.shed - shed0,
+            "queued": queued,
+            "pages_used": self.allocator.used_count,
+            "pages_free": self.allocator.free_count,
+            "pages_shared": self.allocator.shared_count,
+            "prefix_hits": self._pc_hits_total - pc_hits0,
+            "adapter_pins": self.adapter_pool.pinned_count,
+            "tenants": tenants,
+        })
 
 
 # ------------------------------------------------------------ serve binding

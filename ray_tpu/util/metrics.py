@@ -90,6 +90,15 @@ BUILTIN_METRICS: Dict[str, str] = {
     # tracing span plane (util/tracing.py): batched flushes + visible drops
     "ray_tpu_spans_emitted_total": "counter",
     "ray_tpu_spans_dropped_total": "counter",
+    # engine step flight recorder (util/steprec.py ring; serve/engine.py
+    # records; core/head.py h_engine_step_batch joins)
+    "ray_tpu_step_records_flushed_total": "counter",
+    "ray_tpu_step_records_dropped_total": "counter",
+    "ray_tpu_engine_stall_seconds_total": "counter",
+    # device-memory accounting (util/devmem.py)
+    "ray_tpu_devmem_pool_bytes": "gauge",
+    # on-demand profiler capture (core/worker_main.py profile handler)
+    "ray_tpu_profile_captures_total": "counter",
 }
 
 _registry_lock = threading.Lock()

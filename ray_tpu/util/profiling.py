@@ -22,15 +22,47 @@ dry runs exercise the same code path as TPU runs.
 from __future__ import annotations
 
 import contextlib
+import logging
+import threading
 from typing import Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+#: jax.profiler is process-global: one capture at a time.  Guarded here so
+#: a second ``device_trace`` fails TYPED instead of raising deep inside
+#: start_trace and leaving the first capture wedged.
+_active_lock = threading.Lock()
+_active_dir: Optional[str] = None
+
+
+class ProfilerBusyError(RuntimeError):
+    """A device trace is already being captured in this process."""
+
+
+def active_trace_dir() -> Optional[str]:
+    """Log dir of the capture in flight, or None when idle."""
+    return _active_dir
 
 
 @contextlib.contextmanager
 def device_trace(log_dir: str, *,
                  host_tracer_level: Optional[int] = None) -> Iterator[None]:
-    """Capture a jax.profiler trace of the enclosed block into ``log_dir``."""
+    """Capture a jax.profiler trace of the enclosed block into ``log_dir``.
+
+    Raises :class:`ProfilerBusyError` when a capture is already active in
+    this process (the underlying profiler is a process-global singleton).
+    A failing ``stop_trace`` is logged, never raised: it must not mask the
+    block's real exception, and the active flag is cleared either way so
+    the next capture isn't wedged behind a corpse.
+    """
+    global _active_dir
     import jax
 
+    with _active_lock:
+        if _active_dir is not None:
+            raise ProfilerBusyError(
+                f"device trace already capturing into {_active_dir!r}")
+        _active_dir = log_dir
     kwargs = {}
     if host_tracer_level is not None:
         try:
@@ -39,11 +71,19 @@ def device_trace(log_dir: str, *,
             )
         except (AttributeError, TypeError):
             pass  # older jax: default options
-    jax.profiler.start_trace(log_dir, **kwargs)
     try:
-        yield
+        jax.profiler.start_trace(log_dir, **kwargs)
+        try:
+            yield
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.warning("jax.profiler.stop_trace failed for %s",
+                               log_dir, exc_info=True)
     finally:
-        jax.profiler.stop_trace()
+        with _active_lock:
+            _active_dir = None
 
 
 @contextlib.contextmanager

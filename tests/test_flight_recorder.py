@@ -1,0 +1,512 @@
+"""Observability plane: engine step flight recorder, device-memory
+accounting, on-demand profiler capture, `ray_tpu top`.
+
+Reference analog: TorchTitan's flight-recorder posture on the serving
+side (PAPERS.md) + the reference's dashboard memory panels / `ray
+status -v` — the decode loop leaves a bounded record trail that reaches
+the head live, survives SIGKILL as an on-disk black box, and renders as
+a cluster table.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import steprec
+
+# Same decode geometry as test_serve_engine: the per-process jit cache
+# is shared across test files, so these engines reuse already-compiled
+# programs instead of paying a fresh compile.
+GEOMETRY = dict(batch_slots=4, page_size=8, max_prompt_len=16,
+                max_new_tokens_cap=32)
+
+# Every field the bench gate (bench_serve.assert_step_records) and the
+# `top`/`status` renderers rely on.
+STEP_FIELDS = {
+    "t", "engine", "step", "wall_s", "stall_s", "occupancy", "slots",
+    "admitted", "evicted", "shed", "queued", "pages_used", "pages_free",
+    "pages_shared", "prefix_hits", "adapter_pins", "tenants",
+}
+
+
+def _tiny_engine(**overrides):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+    cfg = LlamaConfig.tiny(remat=False, dtype=jnp.float32)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    kw = dict(GEOMETRY, max_queue=16)
+    kw.update(overrides)
+    return InferenceEngine(cfg, params, EngineConfig(**kw), seed=0)
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _cli(*argv, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "--address",
+         os.environ["RT_ADDRESS"], *argv],
+        capture_output=True, text=True, env=dict(os.environ),
+        timeout=timeout,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ring semantics: bounded, drop-counted, black-box mirrored.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def small_ring(monkeypatch):
+    """Shrink the recorder's config without touching the global Config
+    (steprec resolves every limit through its _cfg hook)."""
+    cfg = types.SimpleNamespace(
+        step_ring_size=16, step_dump_records=8, step_dump_interval_s=0.0)
+    steprec.drain_buffered()
+    monkeypatch.setattr(steprec, "_cfg", lambda: cfg)
+    yield cfg
+    steprec.drain_buffered()
+
+
+def test_step_ring_bounded_and_drops_counted(small_ring):
+    """Overflow must DROP (counted), never grow or block: the ring is on
+    the decode loop's hot path."""
+    dropped0 = steprec.dropped_total()
+    for i in range(40):
+        steprec.record_step({"engine": "ringtest.0", "step": i})
+    buffered = steprec.drain_buffered()
+    assert len(buffered) == 16  # ring capacity, not 40
+    assert [r["step"] for r in buffered] == list(range(16))  # oldest kept
+    assert steprec.dropped_total() - dropped0 == 24  # every loss counted
+
+
+def test_black_box_last_n_atomic_and_throttled(small_ring, tmp_path,
+                                               monkeypatch):
+    """The sidecar holds the LAST N records (JSON lines), rewrites are
+    throttled by step_dump_interval_s, and the path derives from
+    RT_LOG_PATH so the post-mortem glob finds it next to the log."""
+    monkeypatch.setenv("RT_LOG_PATH", str(tmp_path / "worker-abc.log"))
+    assert steprec.black_box_path() == str(tmp_path / "worker-abc.steps.log")
+
+    for i in range(20):
+        steprec.record_step({"engine": "boxtest.0", "step": i})
+    box = tmp_path / "box.steps.log"
+    assert steprec.dump_black_box(str(box), force=True)
+    lines = [ln for ln in box.read_text().splitlines()
+             if not ln.startswith("#")]
+    assert len(lines) == 8  # step_dump_records mirror, not the full ring
+    assert [json.loads(ln)["step"] for ln in lines] == list(range(12, 20))
+
+    # Throttle: a non-forced dump inside the interval is a no-op.
+    small_ring.step_dump_interval_s = 3600.0
+    box.write_text("sentinel-unchanged")
+    assert not steprec.dump_black_box(str(box))
+    assert box.read_text() == "sentinel-unchanged"
+    # force bypasses the throttle (the exit/crash path).
+    assert steprec.dump_black_box(str(box), force=True)
+    assert "boxtest.0" in box.read_text()
+
+
+# ---------------------------------------------------------------------------
+# Device-memory accounting.
+# ---------------------------------------------------------------------------
+
+
+def test_devmem_pools_sum_to_live_bytes():
+    """The attribution invariant: pools (including "other") sum EXACTLY
+    to live array bytes; a raising pool fn reports 0; over-attribution
+    (stale fn racing a teardown) scales down instead of driving "other"
+    negative."""
+    import jax.numpy as jnp
+
+    from ray_tpu.util import devmem
+
+    anchor = jnp.arange(4096.0)  # keeps live_bytes > 0
+    anchor.block_until_ready()
+    try:
+        devmem.register_pool("t_anchor", lambda: anchor.nbytes)
+        devmem.register_pool("t_raises", lambda: 1 // 0)
+        snap = devmem.snapshot()
+        assert snap["live_bytes"] >= anchor.nbytes
+        assert sum(snap["pools"].values()) == snap["live_bytes"]
+        assert snap["pools"]["t_anchor"] == anchor.nbytes
+        assert snap["pools"]["t_raises"] == 0
+        assert snap["pools"]["other"] >= 0
+
+        # Over-attribution: a pool claiming 10x live must be scaled, the
+        # sum invariant and other>=0 must still hold.
+        devmem.register_pool("t_liar", lambda: snap["live_bytes"] * 10)
+        snap2 = devmem.snapshot()
+        assert sum(snap2["pools"].values()) == snap2["live_bytes"]
+        assert snap2["pools"]["other"] >= 0
+        assert snap2["pools"]["t_liar"] <= snap2["live_bytes"]
+    finally:
+        for name in ("t_anchor", "t_raises", "t_liar"):
+            devmem.unregister_pool(name)
+
+    devmem.record_compile("t_prog", 0.25)
+    devmem.record_compile("t_prog", 0.5)
+    stats = devmem.compile_stats()
+    assert stats["t_prog"]["count"] == 2
+    assert stats["t_prog"]["wall_s"] == pytest.approx(0.75)
+
+
+def test_maybe_snapshot_never_forces_jax_import():
+    """A worker that hasn't touched jax must report nothing (importing
+    XLA into every worker is exactly what maybe_snapshot avoids) — probed
+    in a fresh interpreter where jax is genuinely unimported."""
+    code = (
+        "import sys; from ray_tpu.util import devmem; "
+        "assert 'jax' not in sys.modules; "
+        "assert devmem.maybe_snapshot() is None; "
+        "assert 'jax' not in sys.modules; print('clean')"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "clean" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Profiler capture: exclusivity contract (the live-worker path is below).
+# ---------------------------------------------------------------------------
+
+
+def test_device_trace_busy_is_typed(tmp_path):
+    from ray_tpu.util import profiling
+
+    with profiling.device_trace(str(tmp_path / "a")):
+        assert profiling.active_trace_dir() == str(tmp_path / "a")
+        with pytest.raises(profiling.ProfilerBusyError):
+            with profiling.device_trace(str(tmp_path / "b")):
+                pass
+    assert profiling.active_trace_dir() is None
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: records carry the full schema, slo_signals gains
+# stall/jitter, controller reacts to stall pressure.
+# ---------------------------------------------------------------------------
+
+
+def test_engine_records_full_schema_and_slo_stall_signals():
+    steprec.drain_buffered()
+    eng = _tiny_engine()
+    try:
+        toks = list(eng.submit([3, 5, 7], max_new_tokens=4))
+        assert len(toks) == 4
+        pid, seq = eng.engine_id.split(".")
+        assert int(pid) == os.getpid() and seq.isdigit()
+
+        deadline = time.time() + 5
+        recs = []
+        while time.time() < deadline:
+            recs += [r for r in steprec.drain_buffered()
+                     if r.get("engine") == eng.engine_id]
+            if any(r["occupancy"] > 0 for r in recs):
+                break
+            time.sleep(0.05)
+        assert recs, "decode loop produced no step records"
+        for r in recs:
+            assert STEP_FIELDS <= set(r), STEP_FIELDS - set(r)
+        assert sum(r["admitted"] for r in recs) >= 1
+        assert all(r["wall_s"] >= 0 and r["stall_s"] >= 0 for r in recs)
+
+        sig = eng.slo_signals()
+        for key in ("stall_frac", "stall_s_window", "stall_window_s",
+                    "step_p50_s", "step_p99_s", "step_jitter_p99_s"):
+            assert key in sig, key
+        assert 0.0 <= sig["stall_frac"] <= 1.0
+    finally:
+        eng.shutdown()
+
+
+def test_step_record_off_switch():
+    """step_record=False keeps the decode loop silent (the <=2% overhead
+    contract's escape hatch must actually disconnect the recorder)."""
+    steprec.drain_buffered()
+    eng = _tiny_engine(step_record=False)
+    try:
+        assert list(eng.submit([3, 5], max_new_tokens=3))
+        time.sleep(0.2)
+        assert not [r for r in steprec.drain_buffered()
+                    if r.get("engine") == eng.engine_id]
+    finally:
+        eng.shutdown()
+
+
+def test_scale_decision_stall_pressure():
+    """Stall pressure scales up BEFORE the TTFT breach, and blocks
+    scale-down until comfortably below target (unit, no actors)."""
+    from ray_tpu.serve.controller import _scale_decision
+
+    # Queue and TTFT healthy, stall breached -> scale up.
+    assert _scale_decision(2, 1, 4, per_queue=0.1, target_q=2.0,
+                           stall_frac=0.6, target_stall_frac=0.25) == 3
+    # Everything comfortably idle (stall < target/2) -> scale down.
+    assert _scale_decision(2, 1, 4, per_queue=0.1, target_q=2.0,
+                           stall_frac=0.05, target_stall_frac=0.25) == 1
+    # Stall in the gray zone [target/2, target): hold, don't shrink.
+    assert _scale_decision(2, 1, 4, per_queue=0.1, target_q=2.0,
+                           stall_frac=0.2, target_stall_frac=0.25) == 2
+    # No stall signal at all: legacy behavior unchanged.
+    assert _scale_decision(2, 1, 4, per_queue=0.1, target_q=2.0) == 1
+
+
+# ---------------------------------------------------------------------------
+# Live plane: transport to the head, list_state kinds, top/profile CLI.
+# ---------------------------------------------------------------------------
+
+
+def test_engine_steps_and_devmem_reach_head_and_top(rt):
+    """End to end: records flushed from this driver land in the head's
+    per-engine ring; a worker that touched jax reports devmem on the
+    metrics cadence; `list`, `status` and `top --once` all render both."""
+    from ray_tpu.core.context import ctx
+
+    eid = f"{os.getpid()}.77"
+    steprec.drain_buffered()
+    for i in range(5):
+        steprec.record_step({
+            "t": float(i), "engine": eid, "step": i, "wall_s": 0.01,
+            "stall_s": 0.0, "occupancy": 2, "slots": 4, "admitted": 1,
+            "evicted": 0, "shed": 0, "queued": 0, "pages_used": 3,
+            "pages_free": 13, "pages_shared": 0, "prefix_hits": 0,
+            "adapter_pins": 0, "tenants": {"default": 2},
+        })
+    assert steprec.flush_steps(ctx.client) == 5
+
+    @ray_tpu.remote
+    def touch_jax():
+        import jax.numpy as jnp
+
+        return int(jnp.arange(8.0).sum())
+
+    assert ray_tpu.get(touch_jax.remote(), timeout=120) == 28
+
+    rows = []
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        rows = ctx.client.call(
+            "list_state", {"kind": "engine_steps", "engine": eid})["items"]
+        if rows:
+            break
+        time.sleep(0.2)
+    assert rows and rows[0]["engine"] == eid
+    assert rows[0]["latest"]["step"] == 4
+    assert len(rows[0]["records"]) == 5
+    # limit trims the window tail-first.
+    rows = ctx.client.call(
+        "list_state", {"kind": "engine_steps", "engine": eid,
+                       "limit": 2})["items"]
+    assert [r["step"] for r in rows[0]["records"]] == [3, 4]
+
+    # The jax-touching worker's devmem report arrives on the metrics
+    # cadence (its reporter thread snapshots only once jax is imported).
+    dm = []
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        dm = ctx.client.call("list_state", {"kind": "devmem"})["items"]
+        if dm:
+            break
+        time.sleep(0.3)
+    assert dm, "no worker ever reported a devmem snapshot"
+    snap = dm[0]["devmem"]
+    assert sum(snap["pools"].values()) == snap["live_bytes"]
+    assert dm[0]["worker_id"] and dm[0]["node_id"]
+
+    out = _cli("list", "engine_steps")
+    assert out.returncode == 0, out.stderr
+    assert eid in out.stdout
+    out = _cli("list", "devmem")
+    assert out.returncode == 0, out.stderr
+    assert str(dm[0]["pid"]) in out.stdout
+
+    out = _cli("status")
+    assert out.returncode == 0, out.stderr
+    assert f"engine {eid}" in out.stdout
+    assert "stall" in out.stdout
+
+    out = _cli("top", "--once")
+    assert out.returncode == 0, out.stderr
+    assert "ray_tpu top" in out.stdout
+    assert eid in out.stdout  # the engine table rendered
+    assert "2/4" in out.stdout  # slots occupancy/total from the record
+
+
+def test_profile_cli_captures_worker_trace(rt, tmp_path):
+    """`ray_tpu profile <worker>` round-trips head -> worker: the worker
+    wraps itself in device_trace for N seconds (on a side thread — the
+    actor keeps serving) and the reply names a TensorBoard-readable
+    trace dir."""
+    from ray_tpu.core.context import ctx
+
+    @ray_tpu.remote
+    class Burner:
+        def warm(self):
+            import jax.numpy as jnp
+
+            return int(jnp.arange(4.0).sum())
+
+        def spin(self, seconds):
+            import jax.numpy as jnp
+
+            deadline = time.time() + seconds
+            x = jnp.arange(1.0, 1025.0)
+            while time.time() < deadline:
+                x = (x * 1.0001).block_until_ready()
+            return float(x[0])
+
+    b = Burner.remote()
+    assert ray_tpu.get(b.warm.remote(), timeout=120) == 6  # jax imported
+
+    workers = ctx.client.call("list_state", {"kind": "workers"})["items"]
+    actor_workers = [w for w in workers if w["state"] == "actor"]
+    assert actor_workers
+    wid = actor_workers[0]["worker_id"]
+
+    spin_ref = b.spin.remote(4.0)  # device work DURING the capture
+    logdir = str(tmp_path / "tb")
+    out = _cli("profile", wid, "--seconds", "1.5", "--logdir", logdir)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert f"trace dir: {logdir}" in out.stdout
+    assert "tensorboard --logdir" in out.stdout
+    traces = glob.glob(f"{logdir}/**/plugins/profile/**/*", recursive=True)
+    assert traces, f"no profile output under {logdir}"
+    assert ray_tpu.get(spin_ref, timeout=60) > 0  # capture didn't disturb it
+
+    # Unknown worker: a clean error, not a hang.
+    out = _cli("profile", "ffffffff", "--seconds", "0.5")
+    assert out.returncode == 1
+    assert out.stderr.strip()
+
+
+# ---------------------------------------------------------------------------
+# Crash forensics: the black box outlives SIGKILL.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_black_box_survives_sigkill_postmortem(rt):
+    """A SIGKILLed worker runs no exit hook — the sidecar written AHEAD
+    of death is the only record of its final steps, and `ray_tpu logs
+    --post-mortem` (a separate driver) must surface it."""
+
+    @ray_tpu.remote
+    class Doomed:
+        def record(self):
+            from ray_tpu.util import steprec as sr
+
+            for i in range(6):
+                sr.record_step({
+                    "engine": f"{os.getpid()}.0", "step": i,
+                    "t": float(i), "wall_s": 0.01, "stall_s": 0.0,
+                    "sentinel": "BLACKBOX-SENTINEL-93251",
+                })
+            assert sr.dump_black_box(force=True)
+            return sr.black_box_path(), os.getpid()
+
+    d = Doomed.remote()
+    box_path, pid = ray_tpu.get(d.record.remote(), timeout=120)
+    assert box_path and box_path.endswith(".steps.log")
+    assert os.path.exists(box_path)
+
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.1)
+        except OSError:
+            break
+
+    assert os.path.exists(box_path)  # the box outlived the process
+    text = open(box_path).read()
+    assert "BLACKBOX-SENTINEL-93251" in text
+
+    out = _cli("logs", "--post-mortem")
+    assert out.returncode == 0, out.stderr
+    assert "BLACKBOX-SENTINEL-93251" in out.stdout
+    assert ".steps.log" in out.stdout  # surfaced as a named sidecar
+
+
+# ---------------------------------------------------------------------------
+# Headless hold -> replay through a head restart.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_headless_step_records_hold_and_replay(tmp_path, monkeypatch):
+    """Records emitted while the head is DOWN stay in the bounded ring
+    (flush is a no-op, nothing is lost) and replay into the restarted
+    head's engine ring on the first post-reconnect flush — the span
+    plane's exact survival contract, for step records."""
+    from ray_tpu.cluster_utils import ExternalHead
+
+    monkeypatch.setenv("RT_HEAD_RECONNECT_DEADLINE_S", "20")
+    monkeypatch.delenv("RT_ADDRESS", raising=False)
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    head = ExternalHead(state_path=str(tmp_path / "head.state"), num_cpus=2)
+    try:
+        ray_tpu.init(address=head.addr)
+        from ray_tpu.core.context import ctx as rt_ctx
+
+        eid = f"{os.getpid()}.88"
+        steprec.drain_buffered()
+        steprec.record_step({"engine": eid, "step": 0, "t": 0.0})
+        assert steprec.flush_steps(rt_ctx.client) == 1
+
+        head.kill()
+        obs_deadline = time.monotonic() + 10
+        while not rt_ctx.client.rpc.closed \
+                and time.monotonic() < obs_deadline:
+            time.sleep(0.05)
+        assert rt_ctx.client.rpc.closed
+
+        # Emitted INSIDE the outage window.
+        steprec.record_step({"engine": eid, "step": 1, "t": 1.0})
+        assert steprec.flush_steps(rt_ctx.client) == 0  # headless: held
+        head.restart()
+
+        # The background flusher replays the held record by itself.
+        steps = set()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                rows = rt_ctx.client.call(
+                    "list_state",
+                    {"kind": "engine_steps", "engine": eid})["items"]
+            except Exception:
+                rows = []
+            steps = {r["step"] for row in rows
+                     for r in row.get("records", [])}
+            if 1 in steps:
+                break
+            time.sleep(0.5)
+        assert 1 in steps, (
+            "step record emitted while headless was lost across restart")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        head.shutdown()
