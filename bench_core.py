@@ -188,11 +188,38 @@ def bench_single_node(quick: bool):
     for _ in range(2):
         put_gib()
         time.sleep(0.8)  # frees -> cooling -> pool
+    # Stage attribution (core/object_store.py put-path accounting): the
+    # measured loop's wall splits into named stages — the committed
+    # baseline the zero-copy object-plane redesign (ROADMAP item 3) must
+    # move.  Written next to BENCH_CORE.json as PUT_STAGES.json.
+    from ray_tpu.core import object_store as _ostore
+
+    _ostore.reset_put_stages()
     n, t0 = 0, time.perf_counter()
     while time.perf_counter() - t0 < (2.0 if quick else 5.0):
         put_gib()
         n += 1
-    record("single_client_put_gib", n / (time.perf_counter() - t0), "GiB/s")
+    put_wall = time.perf_counter() - t0
+    record("single_client_put_gib", n / put_wall, "GiB/s")
+    stages = _ostore.put_stage_snapshot()
+    attributed = sum(v["seconds"] for v in stages.values())
+    table = {
+        "row": "single_client_put_gib",
+        "wall_s": round(put_wall, 4),
+        "attributed_s": round(attributed, 4),
+        "attributed_frac": round(attributed / put_wall, 4),
+        "stages": {
+            k: {"seconds": round(v["seconds"], 4), "bytes": v["bytes"],
+                "count": v["count"],
+                "frac_of_wall": round(v["seconds"] / put_wall, 4)}
+            for k, v in sorted(stages.items())
+        },
+    }
+    with open(os.path.join(os.path.dirname(__file__),
+                           "PUT_STAGES.json"), "w") as f:
+        json.dump(table, f, indent=1)
+    print(f"  put-stage attribution: {table['attributed_frac']:.0%} of "
+          f"{put_wall:.1f}s wall -> PUT_STAGES.json", file=sys.stderr)
 
     big_ref = ray_tpu.put(arr)
 

@@ -19,6 +19,7 @@ from .. import exceptions
 from . import serialization
 from .config import get_config
 from .ids import NodeID, ObjectID
+from . import object_store
 from .object_store import StoreClient
 from .rpc import ConnectionLost, RpcClient
 from ..devtools.locks import guarded, make_lock
@@ -488,8 +489,17 @@ class Client:
 
     def put_with_id(self, oid: ObjectID, value: Any) -> int:
         cfg = get_config()
+        _t0 = time.perf_counter()
         meta, buffers = serialization.serialize(value)
         size = serialization.packed_size(meta, buffers)
+        # Contention accounting (doctor --object-plane): the large-put wall
+        # splits into serialize (here) / alloc / first_touch (StoreClient)
+        # / copy (pack_into below).  Inline puts skip the bookkeeping — two
+        # histogram observes would be real overhead on a ~100us path.
+        _large = size > cfg.inline_object_max_bytes and not self.proxy
+        if _large:
+            object_store.note_put_stage(
+                "serialize", time.perf_counter() - _t0, size)
         if size <= cfg.inline_object_max_bytes:
             blob = bytearray(size)
             serialization.pack_into(meta, buffers, memoryview(blob))
@@ -530,7 +540,10 @@ class Client:
                 recent = time.monotonic() - self._last_large_free < 0.5
             wait = 0.06 if recent else 0.0
             buf = self.store().create(oid, size, wait_pool_s=wait)
+            _t1 = time.perf_counter()
             serialization.pack_into(meta, buffers, buf)
+            object_store.note_put_stage(
+                "copy", time.perf_counter() - _t1, size)
             with self._local_lock:
                 self.large_oids[oid.binary()] = size
             # Registration rides the put batch (same-connection FIFO keeps
@@ -542,7 +555,10 @@ class Client:
                     {"object_id": oid.binary(), "size": size,
                      "node_id": self.node_id.binary()}
                 )
+            _t2 = time.perf_counter()
             self._flush_put_batch()
+            object_store.note_put_stage(
+                "register", time.perf_counter() - _t2, 0)
         return size
 
     @contextlib.contextmanager

@@ -287,6 +287,39 @@ class Config:
     # must not grow head memory without bound; new series beyond the cap
     # are dropped (the ones already retained keep recording).
     metrics_history_max_series: int = 1024
+    # -- health / incident plane (util/health.py, head wiring) ----------------
+    # Master switch for the head's detector pass.  Detectors run on the
+    # telemetry sampling cadence; the pass is O(watched series) and adds
+    # no RPCs, so it stays on by default.
+    health_enabled: bool = True
+    # Suspicion window the counter-delta detectors (partition, drops,
+    # stall pressure, head loop lag) evaluate over.
+    health_window_s: float = 30.0
+    # Hysteresis: an open incident whose detector stays quiet this long
+    # flips to resolved (and stays in the bounded ring for `doctor`).
+    health_resolve_after_s: float = 20.0
+    # Bounded incident ring on the head (head-volatile, like the
+    # timeline): oldest-resolved evict first.
+    health_max_incidents: int = 256
+    # SLO availability goal for the serve burn-rate detector: the error
+    # budget is 1 - goal (0.95 -> 5% of requests may breach the latency
+    # target before the budget burns).
+    health_slo_goal: float = 0.95
+    # Explicit TTFT/ITL targets (seconds) for the burn-rate detector.
+    # 0 = take the targets serve deployments declare (autoscaling
+    # target_ttft_s, published to the head at deploy); with neither, the
+    # SLO detector stays silent — no target means no budget to burn.
+    health_slo_ttft_s: float = 0.0
+    health_slo_itl_s: float = 0.0
+    # Multi-window burn evaluation spans (Google-SRE shape: BOTH windows
+    # must burn above threshold for a firing).
+    health_slo_fast_window_s: float = 60.0
+    health_slo_slow_window_s: float = 300.0
+    # Push-style alerting for incident open/resolve transitions:
+    # "" disables, "log" writes WARNING lines to the head log, an
+    # http(s):// URL gets a JSON POST per transition (fire-and-forget on
+    # a daemon thread — a dead webhook never blocks the head loop).
+    alert_sink: str = ""
     # -- debugging plane ------------------------------------------------------
     # Cluster-wide log index: every worker/daemon registers its log file at
     # startup; entries of exited processes are RETAINED for crash

@@ -256,7 +256,10 @@ class Dataplane:
         except Exception:
             pass
 
-    def _count_quarantine(self):
+    def _count_quarantine(self, addr: str = ""):
+        # Tagged by peer addr so the health plane's partition-suspicion
+        # evidence (and `doctor`) can name WHICH peer went gray, not just
+        # that one did; cardinality is bounded by cluster size.
         try:
             if self._quarantine_counter is None:
                 from ..util.metrics import get_counter
@@ -264,8 +267,8 @@ class Dataplane:
                 self._quarantine_counter = get_counter(
                     "ray_tpu_peer_quarantines_total",
                     "Peer routes quarantined for gray failure (stalled or "
-                    "slow-but-alive)")
-            self._quarantine_counter.inc()
+                    "slow-but-alive)", tag_keys=("peer",))
+            self._quarantine_counter.inc(1.0, {"peer": str(addr)})
         except Exception:
             pass
 
@@ -342,7 +345,7 @@ class Dataplane:
         for r in self._routes.values():
             if r.slot is slot:
                 r.slot = None
-        self._count_quarantine()
+        self._count_quarantine(slot.addr)
 
     def _retire_slot(self, slot: _Slot):
         """Lock held.  Take a slot out of service; its connection is closed

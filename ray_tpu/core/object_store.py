@@ -31,6 +31,91 @@ from ..devtools.locks import make_lock, make_rlock
 _SHM_DIR = "/dev/shm"
 _PREFIX = "rtpu"
 
+# -- put-path contention accounting -------------------------------------------
+# Stage attribution for the object-store write path (the committed baseline
+# the zero-copy redesign must move — ROADMAP item 3): every large put's wall
+# splits into serialize / alloc / first_touch / copy, plus the store-lock
+# wait on the daemon's accounting lock.  Two sinks per observation: the
+# cluster histograms (``ray_tpu_put_copy_seconds`` by stage,
+# ``ray_tpu_store_lock_wait_seconds``) for `doctor --object-plane`, and a
+# process-local accumulator bench_core/tests read without a cluster.
+
+#: Cold segments below this size skip the pre-touch pass (the fault cost
+#: of a few pages is noise; the Python per-page loop is not).
+_PRETOUCH_MIN_BYTES = 1024 * 1024
+_PAGE = mmap.PAGESIZE or 4096
+
+_stage_lock = make_lock("store.put_stages")
+_stage_acc: Dict[str, List[float]] = {}  # stage -> [seconds, bytes, count]
+_stage_hist = None
+_lock_hist = None
+
+#: put-stage boundaries (seconds): large-put stages run 1ms..1s.
+_STAGE_BOUNDS = (0.0005, 0.002, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0)
+
+
+def note_put_stage(stage: str, seconds: float, nbytes: int = 0) -> None:
+    """Attribute ``seconds`` of put wall to one named stage."""
+    global _stage_hist
+    if _stage_hist is None:
+        from ..util.metrics import get_histogram
+
+        _stage_hist = get_histogram(
+            "ray_tpu_put_copy_seconds",
+            "Object put wall time split by stage (serialize / alloc / "
+            "first_touch / copy)", boundaries=_STAGE_BOUNDS,
+            tag_keys=("stage",))
+    _stage_hist.observe(seconds, {"stage": stage})
+    with _stage_lock:
+        acc = _stage_acc.get(stage)
+        if acc is None:
+            acc = _stage_acc[stage] = [0.0, 0.0, 0.0]
+        acc[0] += seconds
+        acc[1] += nbytes
+        acc[2] += 1
+
+
+def note_lock_wait(seconds: float) -> None:
+    """Record one store-lock acquisition wait (daemon accounting lock)."""
+    global _lock_hist
+    if _lock_hist is None:
+        from ..util.metrics import get_histogram
+
+        _lock_hist = get_histogram(
+            "ray_tpu_store_lock_wait_seconds",
+            "Wait to acquire the object store's accounting lock",
+            boundaries=(0.0001, 0.001, 0.005, 0.025, 0.1, 0.5, 1.0))
+    _lock_hist.observe(seconds)
+    with _stage_lock:
+        acc = _stage_acc.get("lock_wait")
+        if acc is None:
+            acc = _stage_acc["lock_wait"] = [0.0, 0.0, 0.0]
+        acc[0] += seconds
+        acc[2] += 1
+
+
+def put_stage_snapshot() -> Dict[str, dict]:
+    """Process-local stage totals since start/reset (for bench + doctor)."""
+    with _stage_lock:
+        return {stage: {"seconds": acc[0], "bytes": int(acc[1]),
+                        "count": int(acc[2])}
+                for stage, acc in _stage_acc.items()}
+
+
+def reset_put_stages() -> None:
+    with _stage_lock:
+        _stage_acc.clear()
+
+
+def _pretouch(mm_buf, size: int) -> None:
+    """Fault every page of a cold segment once (one byte store per page)
+    so the copy that follows runs against warm pages — the fault cost
+    becomes a measured ``first_touch`` stage instead of hiding inside the
+    memcpy number.  Freshly created tmpfs segments read as zeros, and the
+    stores write zeros, so content is unchanged."""
+    for off in range(0, size, _PAGE):
+        mm_buf[off] = 0
+
 
 def _seg_path(session: str, object_id: ObjectID) -> str:
     return os.path.join(_SHM_DIR, f"{_PREFIX}-{session}-{object_id.hex()}")
@@ -173,14 +258,25 @@ class ObjectStore:
     def create(self, object_id: ObjectID, size: int) -> memoryview:
         """Allocate a segment for an object; caller writes then calls seal()."""
         self.tick()
+        _t_lk = time.perf_counter()
         with self._lock:
+            note_lock_wait(time.perf_counter() - _t_lk)
             if object_id in self._objects:
                 raise KeyError(f"object {object_id} already exists")
             self._ensure_capacity(size)
             path = _seg_path(self._session, object_id)
+            _t0 = time.perf_counter()
             seg = _claim_pooled(self._session, path, size)
             if seg is None:
                 seg = _Segment(path, size, create=True)
+                note_put_stage("alloc", time.perf_counter() - _t0, size)
+                if size >= _PRETOUCH_MIN_BYTES:
+                    _t1 = time.perf_counter()
+                    _pretouch(seg.mm, size)
+                    note_put_stage("first_touch",
+                                   time.perf_counter() - _t1, size)
+            else:
+                note_put_stage("alloc", time.perf_counter() - _t0, size)
             self._objects[object_id] = seg
             self._used += size
             self.bytes_stored_total += size
@@ -211,7 +307,9 @@ class ObjectStore:
     # -- read path ------------------------------------------------------------
 
     def get(self, object_id: ObjectID) -> Optional[memoryview]:
+        _t_lk = time.perf_counter()
         with self._lock:
+            note_lock_wait(time.perf_counter() - _t_lk)
             seg = self._objects.get(object_id)
             if seg is not None:
                 self._objects.move_to_end(object_id)  # LRU touch
@@ -436,6 +534,7 @@ class StoreClient:
         beats cold first-touch faults by ~10x under memory pressure)."""
         path = _seg_path(self._session, object_id)
         deadline = time.monotonic() + wait_pool_s
+        _t0 = time.perf_counter()
         while True:
             seg = _claim_pooled(self._session, path, size)
             if seg is not None or time.monotonic() >= deadline:
@@ -443,6 +542,15 @@ class StoreClient:
             time.sleep(0.003)
         if seg is None:
             seg = _Segment(path, size, create=True)
+            note_put_stage("alloc", time.perf_counter() - _t0, size)
+            if size >= _PRETOUCH_MIN_BYTES:
+                _t1 = time.perf_counter()
+                _pretouch(seg.mm, size)
+                note_put_stage("first_touch", time.perf_counter() - _t1, size)
+        else:
+            # Pool claim (incl. any bounded wait for a warm segment): the
+            # pages arrive warm, there is no first-touch stage to pay.
+            note_put_stage("alloc", time.perf_counter() - _t0, size)
         with self._lock:
             self._attached[object_id] = seg
         return seg.view()

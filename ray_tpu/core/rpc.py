@@ -16,6 +16,7 @@ import asyncio
 import struct
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import TimeoutError as _cf_TimeoutError
 from typing import Any, Awaitable, Callable, Dict, Optional
@@ -36,6 +37,29 @@ _max_msg_bytes: Optional[int] = None
 # endpoint construction, or programmatically via set_fault_schedule.
 _netfault = None
 _netfault_env_checked = False
+
+# Outbox queue-delay accounting (doctor --object-plane): how long requests
+# sit in the coalescing outbox before the loop drains them — a congested
+# shared loop (the peer dataplane multiplexes many connections over one)
+# shows up here before it shows up anywhere else.  One observation per
+# drained burst (the oldest entry's wait), armed lazily so client-less
+# processes never build the instrument.
+_outbox_hist = None
+
+
+def _note_outbox_delay(seconds: float) -> None:
+    global _outbox_hist
+    if _outbox_hist is None:
+        try:
+            from ..util.metrics import get_histogram
+
+            _outbox_hist = get_histogram(
+                "ray_tpu_rpc_outbox_delay_seconds",
+                "Request wait in the RPC outbox between enqueue and drain",
+                boundaries=(0.0001, 0.001, 0.005, 0.025, 0.1, 0.5, 1.0))
+        except Exception:
+            return
+    _outbox_hist.observe(seconds)
 
 
 def _maybe_arm_netfault():
@@ -416,7 +440,7 @@ class RpcClient:
         with self._seq_lock:
             stranded, self._outbox = self._outbox, []
             self._outbox_scheduled = False
-        for _, _, _, fut in stranded:
+        for _, _, _, fut, _ in stranded:
             if not fut.done():
                 fut.set_exception(ConnectionLost("connection lost"))
 
@@ -431,10 +455,15 @@ class RpcClient:
                 if not batch:
                     self._outbox_scheduled = False
                     return
+            # Outbox queue delay (doctor --object-plane): the oldest entry
+            # in the batch waited longest between enqueue and drain — one
+            # histogram observe per burst, not per request, keeps this off
+            # the per-call cost.
+            _note_outbox_delay(time.monotonic() - batch[0][4])
             data = bytearray()
             written: list = []
             nf = _netfault
-            for seq, method, body, fut in batch:
+            for seq, method, body, fut, _ in batch:
                 if fut.done():
                     continue  # e.g. cancelled while queued
                 try:
@@ -521,7 +550,8 @@ class RpcClient:
         with self._seq_lock:
             self._seq += 1
             fut._rt_seq = self._seq  # call()'s timeout abandon keys on this
-            self._outbox.append((self._seq, method, body, fut))
+            self._outbox.append(
+                (self._seq, method, body, fut, time.monotonic()))
             wake = not self._outbox_scheduled
             if wake:
                 self._outbox_scheduled = True

@@ -52,7 +52,13 @@ class MetricsHistory:
     def record(self, rows: List[dict], ts: Optional[float] = None) -> None:
         """Append one sample per series from aggregated metric rows.
         Histogram rows record their cumulative count (rate-of-change over
-        the ring is the observation rate)."""
+        the ring is the observation rate).
+
+        Points are ``[ts, mean, min, max]``: samples arriving inside a
+        series' ``min_interval_s`` bucket fold into the open point's
+        running mean and min/max instead of being dropped — burn-rate and
+        anomaly consumers need the extremes the mean would average away,
+        and sparkline consumers keep reading indices 0/1 unchanged."""
         now = ts if ts is not None else time.time()
         with self._lock:
             for row in rows:
@@ -71,11 +77,19 @@ class MetricsHistory:
                         "kind": row.get("kind", "gauge"),
                         "points": deque(maxlen=self.max_samples),
                         "last_ts": 0.0,
+                        "bucket_n": 0,
                     }
-                if now - s["last_ts"] < self.min_interval_s:
+                v = float(value)
+                if now - s["last_ts"] < self.min_interval_s and s["points"]:
+                    p = s["points"][-1]
+                    s["bucket_n"] += 1
+                    p[1] += (v - p[1]) / s["bucket_n"]
+                    p[2] = min(p[2], v)
+                    p[3] = max(p[3], v)
                     continue
                 s["last_ts"] = now
-                s["points"].append((now, float(value)))
+                s["bucket_n"] = 1
+                s["points"].append([now, v, v, v])
 
     def _evict_stale(self, now: float) -> bool:
         """Make room at the series cap by dropping the longest-idle series,
@@ -94,7 +108,7 @@ class MetricsHistory:
         with self._lock:
             return [
                 {"name": s["name"], "tags": s["tags"], "kind": s["kind"],
-                 "points": [[t, v] for t, v in s["points"]]}
+                 "points": [list(p) for p in s["points"]]}
                 for s in self._series.values()
                 if s["name"].startswith(name_prefix)
             ]
@@ -162,12 +176,32 @@ class HeadMetrics:
             "Field-state resync reports adopted at re-register (nodes "
             "replaying store manifests, workers re-binding live actors)",
             tag_keys=("kind",), register=False)
+        # -- health / incident plane (util/health.py, wired in the head) ------
+        self.incidents_opened = Counter(
+            "ray_tpu_incidents_opened_total",
+            "Incidents opened by the health detector pass",
+            tag_keys=("kind",), register=False)
+        self.incidents_resolved = Counter(
+            "ray_tpu_incidents_resolved_total",
+            "Incidents resolved after their detector went quiet",
+            register=False)
+        self.loop_lag = Gauge(
+            "ray_tpu_head_loop_lag_seconds",
+            "Head event-loop scheduling lag measured by the periodic-tick "
+            "probe (how late the tick woke up)", register=False)
+        self.rpc_handler = Histogram(
+            "ray_tpu_head_rpc_handler_seconds",
+            "Head RPC handler wall time per method",
+            boundaries=self._LATENCY_BOUNDS, tag_keys=("method",),
+            register=False)
         self._all = [
             self.submit_to_start, self.queue_depth, self.tasks_dispatched,
             self.task_duration, self.store_used, self.store_capacity,
             self.store_stored, self.store_transferred, self.store_hit_rate,
             self.lease_revocations,
             self.head_restarts, self.headless_seconds, self.resync_reports,
+            self.incidents_opened, self.incidents_resolved, self.loop_lag,
+            self.rpc_handler,
         ]
 
     def sample_store(self, stats: dict) -> None:

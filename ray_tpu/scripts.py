@@ -79,6 +79,8 @@ _LIST_COLUMNS = {
              "log_path"],
     "task_events": ["task_id", "name", "state", "node_id", "worker_id",
                     "error"],
+    "incidents": ["id", "kind", "severity", "state", "fired_count",
+                  "summary"],
 }
 
 
@@ -105,6 +107,9 @@ def cmd_status(args) -> int:
         actors = cl.call("list_state", {"kind": "actors"})["items"]
         total = cl.call("cluster_resources")["resources"]
         avail = cl.call("available_resources")["resources"]
+        health = _health_line(cl)
+        if health:
+            print(health)
         print(f"nodes: {sum(1 for n in nodes if n.get('alive'))} alive / "
               f"{len(nodes)}")
         print(f"workers: {len(workers)}  actors: "
@@ -218,9 +223,11 @@ def _render_top(cl) -> str:
         "list_state", {"kind": "engine_steps", "limit": 64})["items"]
     devmem = cl.call("list_state", {"kind": "devmem"})["items"]
     alive = sum(1 for n in nodes if n.get("alive"))
+    health = _health_line(cl)
     sections = [
         f"ray_tpu top  {_time.strftime('%H:%M:%S')}  "
-        f"nodes {alive}/{len(nodes)} alive  workers {len(workers)}",
+        f"nodes {alive}/{len(nodes)} alive  workers {len(workers)}"
+        + (f"  |  {health}" if health else ""),
         "",
         _format_table(
             [_node_row(n) for n in nodes],
@@ -396,6 +403,191 @@ def cmd_trace(args) -> int:
         from .util import trace_analysis
 
         print(trace_analysis.format_trace(spans))
+    finally:
+        cl.close()
+    return 0
+
+
+def _health_line(cl) -> Optional[str]:
+    """One-line cluster health grade for `status` and the `top` header;
+    None against a head without the incident plane."""
+    try:
+        reply = cl.call("list_state", {"kind": "incidents"})
+    except Exception:
+        return None
+    grade = reply.get("grade", "OK")
+    n = reply.get("open", 0)
+    line = f"health: {grade}  open incidents: {n}"
+    if n:
+        worst = next((i for i in reply.get("items", [])
+                      if i.get("state") != "resolved"), None)
+        if worst:
+            line += f"  ({worst['kind']}: {worst['summary']})"
+    return line
+
+
+def _age(now: float, ts) -> str:
+    if not isinstance(ts, (int, float)):
+        return ""
+    d = max(0.0, now - ts)
+    return f"{d:.0f}s" if d < 120 else f"{d / 60:.0f}m"
+
+
+def cmd_incidents(args) -> int:
+    """Incident ring of the health plane: every detector firing that
+    opened an incident, with lifecycle state and dedup counts."""
+    import time as _time
+
+    cl = _client(args.address)
+    try:
+        reply = cl.call("list_state", {"kind": "incidents"})
+        items = reply["items"]
+        if args.json:
+            print(json.dumps(
+                {"grade": reply.get("grade"), "open": reply.get("open"),
+                 "incidents": items}, indent=1, default=str))
+            return 0
+        print(f"health: {reply.get('grade', 'OK')}  "
+              f"open: {reply.get('open', 0)}  total: {len(items)}")
+        now = _time.time()
+        rows = [{
+            "id": i.get("id"), "kind": i.get("kind"),
+            "sev": i.get("severity"), "state": i.get("state"),
+            "age": _age(now, i.get("opened")),
+            "fired": i.get("fired_count"),
+            "summary": str(i.get("summary", ""))[:72],
+        } for i in items]
+        _print_table(rows, ["id", "kind", "sev", "state", "age", "fired",
+                            "summary"], empty="(no incidents)")
+    finally:
+        cl.close()
+    return 0
+
+
+def _doctor_object_plane(cl) -> int:
+    """Put-path contention attribution from the cluster-aggregated stage
+    histograms — where the object-plane put wall goes (the measurement
+    gate for the zero-copy redesign, ROADMAP item 3)."""
+    rows = cl.call("list_state", {"kind": "metrics"})["items"]
+
+    def hist_rows(name):
+        return [r for r in rows if r["name"] == name and "sum" in r]
+
+    stages = {}
+    for r in hist_rows("ray_tpu_put_copy_seconds"):
+        stage = r.get("tags", {}).get("stage", "?")
+        cur = stages.setdefault(stage, [0.0, 0])
+        cur[0] += r.get("sum", 0.0)
+        cur[1] += r.get("count", 0)
+    lock = [(r.get("sum", 0.0), r.get("count", 0))
+            for r in hist_rows("ray_tpu_store_lock_wait_seconds")]
+    if lock:
+        stages["lock_wait"] = [sum(s for s, _ in lock),
+                               sum(c for _, c in lock)]
+    outbox = [(r.get("sum", 0.0), r.get("count", 0))
+              for r in hist_rows("ray_tpu_rpc_outbox_delay_seconds")]
+    if not stages:
+        print("(no put-stage samples yet — do a large put first)")
+        return 1
+    total = sum(s for s, _ in stages.values())
+    print("object-plane put attribution (cluster cumulative):")
+    table = [{
+        "stage": stage, "seconds": f"{secs:.4f}", "ops": int(count),
+        "share": f"{100 * secs / total:.1f}%" if total else "-",
+    } for stage, (secs, count) in
+        sorted(stages.items(), key=lambda kv: -kv[1][0])]
+    _print_table(table, ["stage", "seconds", "ops", "share"])
+    if outbox:
+        osum = sum(s for s, _ in outbox)
+        ocnt = sum(c for _, c in outbox)
+        print(f"rpc outbox queue delay: {osum:.4f}s over {ocnt} "
+              "drain bursts")
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    """Root-cause narrative for an incident: replays the evidence chain
+    (trace links, task events, counter deltas) and runs the span-plane
+    critical-path analysis on the slowest linked trace.  Without an id,
+    diagnoses the most recent open incident; --object-plane prints the
+    put-path contention attribution instead."""
+    import time as _time
+
+    cl = _client(args.address)
+    try:
+        if getattr(args, "object_plane", False):
+            return _doctor_object_plane(cl)
+        reply = cl.call("list_state", {"kind": "incidents"})
+        items = reply["items"]
+        if args.incident:
+            items = [i for i in items
+                     if str(i.get("id", "")).startswith(args.incident)]
+            if not items:
+                print(f"(no incident matching {args.incident!r})")
+                return 1
+        else:
+            open_items = [i for i in items if i.get("state") != "resolved"]
+            items = open_items or items
+            if not items:
+                print(f"health: {reply.get('grade', 'OK')} — no incidents "
+                      "recorded; nothing to diagnose")
+                return 0
+        inc = items[0]
+        now = _time.time()
+        print(f"incident {inc['id']}  [{inc['kind']}/{inc['severity']}]  "
+              f"state={inc['state']}")
+        print(f"  {inc['summary']}")
+        print(f"  opened {_age(now, inc.get('opened'))} ago, fired "
+              f"{inc.get('fired_count', 1)}x, last "
+              f"{_age(now, inc.get('last_fired'))} ago"
+              + (f", resolved {_age(now, inc.get('resolved'))} ago"
+                 if inc.get("resolved") else ""))
+        ev = inc.get("evidence") or {}
+        deltas = ev.get("counter_deltas") or (inc.get("data") or {}).get(
+            "deltas")
+        if deltas:
+            print("  counter deltas in window: " + "  ".join(
+                f"{k}=+{v:g}" for k, v in deltas.items()))
+        if ev.get("step_window"):
+            print("  step-record window: " + "  ".join(
+                f"{k}={v}" for k, v in ev["step_window"].items()))
+        for h in ev.get("slowest_handlers") or []:
+            print(f"  handler {h['method']}: {h['total_s']}s "
+                  f"over {h['calls']} calls")
+        for e in (ev.get("task_events") or [])[:5]:
+            print("  event: " + " ".join(
+                f"{k}={v}" for k, v in e.items() if v is not None))
+        tids = ev.get("trace_ids") or []
+        if not tids:
+            print("  (no linked traces in the evidence window)")
+            return 0
+        print(f"  linked traces: {len(tids)}")
+        # Critical path of the slowest linked trace: the narrative's
+        # "where the time actually went" section.
+        slowest, slow_spans, slow_dur = None, None, -1.0
+        for tid in tids:
+            try:
+                spans = cl.call("list_state",
+                                {"kind": "traces", "trace_id": tid})["items"]
+            except Exception:
+                continue
+            if not spans:
+                continue
+            starts = [s["start"] for s in spans
+                      if isinstance(s.get("start"), (int, float))]
+            ends = [s["end"] for s in spans
+                    if isinstance(s.get("end"), (int, float))]
+            dur = (max(ends) - min(starts)) if starts and ends else 0.0
+            if dur > slow_dur:
+                slowest, slow_spans, slow_dur = tid, spans, dur
+        if slow_spans is None:
+            print("  (linked traces already expired from the ring)")
+            return 0
+        from .util import trace_analysis
+
+        print(f"\nslowest linked trace {str(slowest)[:16]} "
+              f"({slow_dur:.3f}s):")
+        print(trace_analysis.format_trace(slow_spans))
     finally:
         cl.close()
     return 0
@@ -641,7 +833,7 @@ def main(argv=None) -> int:
     p.add_argument("kind", choices=[
         "actors", "tasks", "nodes", "workers", "objects",
         "placement_groups", "pgs", "logs", "task_events",
-        "engine_steps", "devmem",
+        "engine_steps", "devmem", "incidents",
     ])
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_list)
@@ -681,6 +873,24 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("status", help="cluster resource summary")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser(
+        "incidents",
+        help="health-plane incident ring (detector firings + lifecycle)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_incidents)
+
+    p = sub.add_parser(
+        "doctor",
+        help="root-cause narrative: replay an incident's evidence chain "
+             "and critical-path the slowest linked trace")
+    p.add_argument("incident", nargs="?", default=None,
+                   help="incident id (prefix ok); omit for the most "
+                        "recent open incident")
+    p.add_argument("--object-plane", action="store_true",
+                   help="print the put-path contention attribution "
+                        "(stage split + store-lock wait + outbox delay)")
+    p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(
         "top", help="auto-refreshing cluster/engine table"

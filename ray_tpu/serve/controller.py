@@ -11,6 +11,7 @@ target (reference: autoscaling_state.py).
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -18,6 +19,32 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def _publish_slo(name: str, spec: Optional[dict]):
+    """Mirror a deployment's latency SLO targets into the head KV
+    (``serve_slo:<deployment>``) so the head's health engine can run SLO
+    burn-rate detection without a serve import.  ``spec=None`` clears the
+    key on undeploy.  Best-effort: KV hiccups must not fail deploy()."""
+    try:
+        from ray_tpu.core.context import ctx
+        if ctx.client is None:
+            return
+        key = f"serve_slo:{name}"
+        targets: Dict[str, float] = {}
+        auto = (spec or {}).get("autoscaling") or {}
+        ttft = auto.get("target_ttft_s")
+        itl = auto.get("target_itl_s")
+        if ttft:
+            targets["ttft"] = float(ttft)
+        if itl:
+            targets["itl"] = float(itl)
+        if spec is not None and targets:
+            ctx.client.kv_put(key, json.dumps(targets).encode())
+        else:
+            ctx.client.kv_del(key)
+    except Exception:
+        pass
 
 
 def _scale_decision(cur: int, min_r: int, max_r: int,
@@ -78,12 +105,14 @@ class ServeController:
             spec["version"] = (old["version"] + 1) if old else 1
             self.targets[name] = spec
             self._version += 1
+        _publish_slo(name, spec)
         return True
 
     def delete(self, name: str) -> bool:
         with self._lock:
             self.targets.pop(name, None)
             self._version += 1
+        _publish_slo(name, None)
         return True
 
     def routing_table(self) -> dict:

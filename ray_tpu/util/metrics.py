@@ -99,6 +99,17 @@ BUILTIN_METRICS: Dict[str, str] = {
     "ray_tpu_devmem_pool_bytes": "gauge",
     # on-demand profiler capture (core/worker_main.py profile handler)
     "ray_tpu_profile_captures_total": "counter",
+    # health / incident plane (core/head.py wiring over util/health.py;
+    # loop-lag + handler histograms are the head's self-observability)
+    "ray_tpu_incidents_opened_total": "counter",
+    "ray_tpu_incidents_resolved_total": "counter",
+    "ray_tpu_head_loop_lag_seconds": "gauge",
+    "ray_tpu_head_rpc_handler_seconds": "histogram",
+    # put-path contention accounting (core/object_store.py stages + lock
+    # waits; core/rpc.py outbox queue delay)
+    "ray_tpu_store_lock_wait_seconds": "histogram",
+    "ray_tpu_put_copy_seconds": "histogram",
+    "ray_tpu_rpc_outbox_delay_seconds": "histogram",
 }
 
 _registry_lock = threading.Lock()

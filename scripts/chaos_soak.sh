@@ -52,7 +52,10 @@ if [ "$MODE" = "head-kill" ]; then
     TARGETS="tests/test_head_crash.py"
     MARK="chaos"
 elif [ "$MODE" = "netfault" ]; then
-    TARGETS="tests/test_netfault.py"
+    # test_health.py's chaos test is the incident-plane assertion for this
+    # mode: a seeded partition under live traffic must open >=1
+    # partition-suspicion incident (with evidence) and resolve after heal.
+    TARGETS="tests/test_netfault.py tests/test_health.py"
     MARK="chaos"
 else
     TARGETS="tests/test_fault_tolerance.py tests/test_chaos.py tests/test_head_crash.py"
@@ -83,5 +86,24 @@ done
 if [ "$fails" -gt 0 ]; then
     echo "chaos soak: $fails/$N iterations flaked"
     exit 1
+fi
+
+if [ "$MODE" = "netfault" ]; then
+    # False-positive gate: with the chaos plane disarmed, a clean serve
+    # smoke plus a clean cluster under live traffic must open ZERO
+    # incidents — the detectors page on faults, not on ordinary load.
+    echo "=== netfault false-positive gate (clean run, no injection) ==="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 \
+        python bench_serve.py --smoke >/dev/null 2>&1; then
+        echo "!!! false-positive gate: clean bench_serve --smoke failed"
+        exit 1
+    fi
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m pytest -q \
+        tests/test_health.py::test_clean_cluster_opens_no_incidents \
+        -p no:cacheprovider -p no:randomly; then
+        echo "!!! false-positive gate: clean cluster opened incidents"
+        exit 1
+    fi
+    echo "netfault false-positive gate: clean (zero incidents)"
 fi
 echo "chaos soak: $N/$N iterations green"

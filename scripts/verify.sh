@@ -37,5 +37,10 @@ if [ "$rc" -ne 0 ]; then
     # scanning /tmp/ray_tpu_logs on this machine.
     echo "=== cluster process log tails (tier-1 run failed, rc=$rc) ==="
     python -m ray_tpu logs --post-mortem --tail 4000 || true
+    # Health-plane snapshot: if a cluster is still reachable, the open
+    # incident ring usually names the failure class (partition, drop
+    # pressure, SLO burn) faster than the raw log tails do.
+    echo "=== open incidents (health plane) ==="
+    python -m ray_tpu incidents 2>/dev/null || true
 fi
 exit "$rc"

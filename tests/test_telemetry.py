@@ -73,7 +73,8 @@ def test_metrics_history_ring():
     assert len(series) == 1
     pts = series[0]["points"]
     assert len(pts) == 4  # ring bounded
-    assert pts[-1] == [105.0, 5.0] and pts[0] == [102.0, 2.0]
+    assert pts[-1] == [105.0, 5.0, 5.0, 5.0]
+    assert pts[0] == [102.0, 2.0, 2.0, 2.0]
     # Series cap with stale eviction: at the cap, a new series evicts the
     # longest-idle DEAD series ("m", idle > 60 s) but a new arrival is
     # dropped while every retained series is still live.
@@ -94,9 +95,16 @@ def test_metrics_history_downsamples():
 
     h = MetricsHistory(max_samples=100, min_interval_s=1.0)
     for i in range(10):
-        h.record([{"name": "m", "tags": {}, "kind": "gauge", "value": 1.0}],
+        h.record([{"name": "m", "tags": {}, "kind": "gauge", "value": float(i)}],
                  ts=100.0 + i * 0.1)  # 10 Hz feed, 1 s min interval
-    assert len(h.snapshot()[0]["points"]) == 1
+    pts = h.snapshot()[0]["points"]
+    assert len(pts) == 1
+    # Within-interval samples fold into the open bucket instead of being
+    # dropped: the point keeps [ts, mean, min, max] of everything seen.
+    ts, mean, lo, hi = pts[0]
+    assert ts == 100.0
+    assert (lo, hi) == (0.0, 9.0)
+    assert abs(mean - 4.5) < 1e-9
 
 
 def test_tracing_public_api_and_aliases():
