@@ -14,8 +14,10 @@ weight broadcast.  Bulk tensor traffic belongs on the device plane
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -23,6 +25,89 @@ import numpy as np
 
 _groups: Dict[str, "GroupState"] = {}
 _POLL_S = 0.002
+
+# -- per-op accounting (gang observability plane) --------------------------
+# Process-wide collective time/bytes accumulator: the train session reads
+# op_totals() before and after each round to attribute collective wait in
+# its round records (util/gangrec.py), without the collective layer knowing
+# anything about gangs.  Every op also observes the
+# ray_tpu_collective_op_seconds / ray_tpu_collective_bytes_total metrics
+# (tagged by op) and emits a propagation-only trace span, so a traced RLHF
+# step shows collective time on the critical path.
+_op_lock = threading.Lock()
+_op_totals = {"ops": 0, "wall_s": 0.0, "bytes": 0}
+_op_by_name: Dict[str, Dict[str, Any]] = {}
+_m_op_hist = None
+_m_op_bytes = None
+
+
+def op_totals() -> Dict[str, Any]:
+    """Process-wide snapshot of collective accounting: total op count,
+    wall seconds, and payload bytes since import.  Monotonic — callers
+    diff two snapshots to attribute a window."""
+    with _op_lock:
+        return dict(_op_totals)
+
+
+def op_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-op breakdown: ``{op: {calls, wall_s, bytes, last_seq}}``."""
+    with _op_lock:
+        return {k: dict(v) for k, v in _op_by_name.items()}
+
+
+def _nbytes(value) -> int:
+    if isinstance(value, np.ndarray):
+        return int(value.nbytes)
+    try:
+        return int(np.asarray(value).nbytes)
+    except Exception:
+        return 0
+
+
+def _observe_op(op: str, wall: float, nbytes: int, seq: int) -> None:
+    global _m_op_hist, _m_op_bytes
+    try:
+        from ..util.metrics import get_counter, get_histogram
+
+        if _m_op_hist is None:
+            _m_op_hist = get_histogram(
+                "ray_tpu_collective_op_seconds",
+                "Wall time of one host-plane collective op, by op",
+                tag_keys=("op",))
+            _m_op_bytes = get_counter(
+                "ray_tpu_collective_bytes_total",
+                "Payload bytes moved through host-plane collectives, by op",
+                tag_keys=("op",))
+        _m_op_hist.observe(wall, {"op": op})
+        if nbytes:
+            _m_op_bytes.inc(nbytes, {"op": op})
+    except Exception:
+        pass  # metrics must never fail a collective
+    with _op_lock:
+        _op_totals["ops"] += 1
+        _op_totals["wall_s"] += wall
+        _op_totals["bytes"] += nbytes
+        s = _op_by_name.setdefault(
+            op, {"calls": 0, "wall_s": 0.0, "bytes": 0, "last_seq": 0})
+        s["calls"] += 1
+        s["wall_s"] += wall
+        s["bytes"] += nbytes
+        s["last_seq"] = max(s["last_seq"], seq)
+
+
+@contextlib.contextmanager
+def _op(g: "GroupState", op: str, tag: str, nbytes: int):
+    """Time one collective op: per-op metrics + process accumulator +
+    (when the caller is traced) a propagation-only child span — untraced
+    callers pay only the clock reads."""
+    from ..util import tracing
+
+    t0 = time.perf_counter()
+    with tracing.trace_if_active(
+            f"collective:{op}", group=g.name, rank=g.rank,
+            world=g.world_size, bytes=nbytes):
+        yield
+    _observe_op(op, time.perf_counter() - t0, nbytes, g.seqs.get(tag, 0))
 
 
 class GroupState:
@@ -289,9 +374,9 @@ def allreduce(tensor: np.ndarray, *, group_name: str = "default",
     if combine is None:
         raise ValueError(f"unsupported op {op!r}")
     g = _group(group_name)
-    out = np.asarray(
-        _tree_exchange(g, "ar", np.asarray(tensor), combine, timeout)
-    )
+    arr = np.asarray(tensor)
+    with _op(g, "allreduce", "ar", _nbytes(arr)):
+        out = np.asarray(_tree_exchange(g, "ar", arr, combine, timeout))
     if op == "mean":
         out = out / g.world_size
     return out
@@ -300,17 +385,26 @@ def allreduce(tensor: np.ndarray, *, group_name: str = "default",
 def allgather(tensor: np.ndarray, *, group_name: str = "default",
               timeout: float = 60.0) -> List[np.ndarray]:
     g = _group(group_name)
-    merged = _tree_exchange(
-        g, "ag", {g.rank: np.asarray(tensor)},
-        lambda a, b: {**a, **b}, timeout,
-    )
+    arr = np.asarray(tensor)
+    with _op(g, "allgather", "ag", _nbytes(arr)):
+        merged = _tree_exchange(
+            g, "ag", {g.rank: arr}, lambda a, b: {**a, **b}, timeout,
+        )
     return [np.asarray(merged[r]) for r in range(g.world_size)]
 
 
 def reducescatter(tensor: np.ndarray, *, group_name: str = "default",
                   op: str = "sum", timeout: float = 60.0) -> np.ndarray:
+    from ..util import tracing
+
     g = _group(group_name)
-    reduced = allreduce(tensor, group_name=group_name, op=op, timeout=timeout)
+    # Span-only wrapper: the wire cost IS the inner allreduce, which does
+    # the metric/accumulator accounting — wrapping it in _op() too would
+    # double-count the wall into the session's collective attribution.
+    with tracing.trace_if_active("collective:reducescatter",
+                                 group=g.name, rank=g.rank):
+        reduced = allreduce(tensor, group_name=group_name, op=op,
+                            timeout=timeout)
     chunks = np.array_split(reduced, g.world_size, axis=0)
     return chunks[g.rank]
 
@@ -321,16 +415,21 @@ def broadcast(tensor: Optional[np.ndarray], *, group_name: str = "default",
     seq = g.next_seq(f"bc{root}")
     key = f"{g.ns}:bc{root}:{seq}"
     if g.rank == root:
-        _post(key, np.asarray(tensor))
+        arr = np.asarray(tensor)
+        with _op(g, "broadcast", f"bc{root}", _nbytes(arr)):
+            _post(key, arr)
         if seq > 2:  # lazy cleanup of an op every rank has long consumed
             _client().kv_del(f"{g.ns}:bc{root}:{seq - 2}")
-        return np.asarray(tensor)
-    return np.asarray(_wait_key(key, timeout))
+        return arr
+    with _op(g, "broadcast", f"bc{root}", 0):
+        out = np.asarray(_wait_key(key, timeout))
+    return out
 
 
 def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
     g = _group(group_name)
-    _tree_exchange(g, "bar", None, lambda a, b: None, timeout)
+    with _op(g, "barrier", "bar", 0):
+        _tree_exchange(g, "bar", None, lambda a, b: None, timeout)
 
 
 def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",
@@ -338,7 +437,9 @@ def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",
     g = _group(group_name)
     chan = f"p2p:{g.rank}->{dst_rank}:{tag}"
     seq = g.next_seq(chan)
-    _post(f"{g.ns}:{chan}:{seq}", np.asarray(tensor))
+    arr = np.asarray(tensor)
+    with _op(g, "send", chan, _nbytes(arr)):
+        _post(f"{g.ns}:{chan}:{seq}", arr)
 
 
 def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
@@ -347,6 +448,7 @@ def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
     chan = f"p2p:{src_rank}->{g.rank}:{tag}"
     seq = g.next_seq(chan)
     key = f"{g.ns}:{chan}:{seq}"
-    value = np.asarray(_wait_key(key, timeout))
+    with _op(g, "recv", chan, 0):
+        value = np.asarray(_wait_key(key, timeout))
     _client().kv_del(key)  # sole reader: safe to clean eagerly
     return value

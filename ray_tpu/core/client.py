@@ -232,14 +232,17 @@ class Client:
                 # batched span_batch entry — the existing background-report
                 # cadence IS the span flush cadence (and while headless the
                 # batch buffers for replay like task_done reports).
+                from ray_tpu.util import gangrec as _gangrec
                 from ray_tpu.util import steprec as _steprec
                 from ray_tpu.util import tracing as _tracing
 
                 _tracing.flush_spans(self)
-                # Flight-recorder plane: engine step records batch-flush on
-                # the same cadence (and dump the black-box sidecar so a
-                # SIGKILL still leaves the last N steps on disk).
+                # Flight-recorder plane: engine step records and gang round
+                # records batch-flush on the same cadence (and dump their
+                # black-box sidecars so a SIGKILL still leaves the last N
+                # steps/rounds on disk).
                 _steprec.flush_steps(self)
+                _gangrec.flush_rounds(self)
                 # Safety net: batched calls must not sit forever in a driver
                 # that stops making client calls (e.g. waits on side effects).
                 self._flush_submit_batch()

@@ -70,6 +70,14 @@ SPAWN_ENV_CONTRACT = {
     "RT_NETFAULT_SEED": "integer seed making the armed schedule's fault "
                         "sequence replayable (chaos_soak.sh --netfault "
                         "rotates it and prints the failing value)",
+    "RT_CHAOS_STRAGGLER": "gang straggler schedule DSL (util/chaos."
+                          "StragglerSchedule): phase=data|compute|"
+                          "checkpoint,ms=,ranks= — the seeded rank "
+                          "sleeps ms in that phase each round; train "
+                          "workers inherit it via the gang runtime_env",
+    "RT_CHAOS_SEED": "integer seed for chaos victim selection — the "
+                     "straggler schedule's rank pick and the kill-"
+                     "cadence tests' RNGs (chaos_soak.sh rotates it)",
     # -- debug switches -------------------------------------------------------
     "RT_DEBUG_PUSH": "worker-side push/exec tracing to stderr",
     "RT_DEBUG_RPC_ERR": "server-side RPC handler error dumps to stderr",
@@ -273,6 +281,25 @@ class Config:
     # Head-side retention: step records kept per engine for
     # list_state(kind="engine_steps") / `ray_tpu top`.
     engine_steps_max_records: int = 1024
+    # Per-process bounded gang round-record ring (util/gangrec.py): the
+    # train session appends one fixed-size record per training round
+    # (step wall, data/collective/ack/checkpoint waits, tokens, MFU);
+    # records flush as one batched gang_round_batch RPC on the
+    # background-report cadence.  Overflow drops (counted in
+    # ray_tpu_gang_rounds_dropped_total), never blocks report().
+    gang_ring_size: int = 2048
+    # Black-box sidecar: the last N round records are mirrored to a
+    # *.rounds.log file next to the worker's log, so a SIGKILLed rank
+    # leaves its final rounds on disk for `ray_tpu logs --post-mortem`.
+    # 0 disables the sidecar.
+    gang_dump_records: int = 256
+    # Minimum seconds between rounds-sidecar rewrites.
+    gang_dump_interval_s: float = 1.0
+    # Head-side retention for the gang join: joined rounds kept per gang
+    # for list_state(kind="gang_rounds") / `ray_tpu gang`, and the cap on
+    # distinct gangs tracked at once (oldest-idle gang evicts first).
+    gang_rounds_max_records: int = 512
+    gang_rounds_max_gangs: int = 64
     # Per-process metrics flusher cadence (util/metrics.py).  An atexit hook
     # ships the final window regardless, so short-lived workers don't lose
     # their last deltas.

@@ -304,6 +304,14 @@ class Head:
         # `ray_tpu top` read them (engine id -> deque of records,
         # oldest-engine evicted when the table itself fills).
         self.engine_steps: "OrderedDict[str, deque]" = OrderedDict()
+        # Gang training observability: per-gang join state fed by
+        # h_gang_round_batch — rounds awaiting a record from every rank
+        # ("pending"), the bounded ring of joined skew profiles, and the
+        # latest raw record per rank.  Oldest-idle gang evicted when the
+        # table hits gang_rounds_max_gangs; read by
+        # list_state("gang_rounds"), `ray_tpu gang`, and the gang health
+        # detectors.
+        self.gang_rounds: "OrderedDict[str, dict]" = OrderedDict()
         # Device-memory accounting: latest util/devmem snapshot per
         # reporting worker pid, identity-joined at report time.
         self.devmem_by_pid: Dict[int, dict] = {}
@@ -430,7 +438,8 @@ class Head:
             "task_blocked", "task_unblocked", "health_ack", "pg_ready",
             "node_health_ack", "node_stats", "node_drain", "span_batch",
             "get_log", "stack_dump", "stack_dump_reply",
-            "engine_step_batch", "devmem_report", "profile", "profile_reply",
+            "engine_step_batch", "gang_round_batch", "devmem_report",
+            "profile", "profile_reply",
             "resolve_actor", "lease_request", "lease_return", "lease_renew",
             "direct_done",
         ]:
@@ -1811,10 +1820,16 @@ class Head:
             steps.extend(r for r in ring
                          if isinstance(r.get("t"), (int, float))
                          and r["t"] >= horizon)
+        profiles: List[dict] = []
+        for st in self.gang_rounds.values():
+            profiles.extend(pr for pr in st["profiles"]
+                            if isinstance(pr.get("t"), (int, float))
+                            and pr["t"] >= horizon)
         self.health.tick(
             now, rows, steps, self.devmem_by_pid, self._loop_lag_s,
             slo_targets=self._serve_slo_targets(),
-            evidence=self._gather_evidence)
+            evidence=self._gather_evidence,
+            gang_profiles=profiles)
 
     def _serve_slo_targets(self) -> Dict[str, float]:
         """TTFT/ITL targets for the burn-rate detector: explicit config
@@ -1874,6 +1889,14 @@ class Head:
         if f["kind"] in ("stall_pressure", "step_jitter"):
             ev_chain["step_window"] = {
                 k: v for k, v in data.items() if k != "engine"}
+        if f["kind"].startswith("gang_"):
+            # Gang incidents: the offending rank/phase plus the worst
+            # joined rounds from the suspicion window (the detector
+            # already ranked them) — what `ray_tpu doctor` replays.
+            for k in ("rank", "phase", "gang", "worst_rounds",
+                      "skew_frac", "data_frac", "coll_frac"):
+                if k in data:
+                    ev_chain[k] = data[k]
         if f["kind"] == "head_pressure":
             rows = self.builtin_metrics.rpc_handler._snapshot()
             slow = sorted(
@@ -3084,6 +3107,63 @@ class Head:
             ring.append(rec)
         return {}
 
+    async def h_gang_round_batch(self, conn, body):
+        """Batched gang round records (util/gangrec ring flush, the train
+        session's per-rank flight recorder).  Joined by (gang, round):
+        the moment a round holds a record from EVERY rank it collapses
+        into one skew profile (gangrec.skew_profile) — which rank arrived
+        last and which phase made it late — retained in a bounded
+        per-gang ring for list_state("gang_rounds") / `ray_tpu gang` and
+        the gang health detectors.  Malformed entries are skipped so one
+        bad record can't drop a gang's whole batch."""
+        from ..util import gangrec as _gangrec
+        cap = max(16, self.config.gang_rounds_max_records)
+        for rec in body["rounds"]:
+            if not isinstance(rec, dict) or not rec.get("gang") \
+                    or not isinstance(rec.get("round"), int) \
+                    or not isinstance(rec.get("rank"), int):
+                continue
+            gid = str(rec["gang"])
+            st = self.gang_rounds.get(gid)
+            if st is None:
+                # Bound the gang table itself (gang churn must not grow
+                # it forever): evict the least-recently-fed gang.
+                while len(self.gang_rounds) >= max(
+                        1, self.config.gang_rounds_max_gangs):
+                    self.gang_rounds.popitem(last=False)
+                st = self.gang_rounds[gid] = {
+                    "pending": OrderedDict(),  # round -> {rank: rec}
+                    "profiles": deque(maxlen=cap),
+                    "world": 0, "last_t": 0.0,
+                    "latest_by_rank": {},
+                }
+            else:
+                self.gang_rounds.move_to_end(gid)
+            world = rec.get("world")
+            if isinstance(world, int) and world > 0:
+                st["world"] = world
+            t = rec.get("t")
+            if isinstance(t, (int, float)):
+                st["last_t"] = max(st["last_t"], float(t))
+            st["latest_by_rank"][rec["rank"]] = rec
+            pend = st["pending"]
+            rnd = pend.get(rec["round"])
+            if rnd is None:
+                # Bound the join buffer: a rank that died mid-round leaves
+                # a forever-incomplete round behind — evict oldest-first.
+                while len(pend) >= 64:
+                    pend.popitem(last=False)
+                rnd = pend[rec["round"]] = {}
+            rnd[rec["rank"]] = rec
+            if st["world"] and len(rnd) >= st["world"]:
+                del pend[rec["round"]]
+                prof = _gangrec.skew_profile(rnd)
+                if prof is not None:
+                    st["profiles"].append(prof)
+                    self.builtin_metrics.gang_round_skew.observe(
+                        prof["skew_s"])
+        return {}
+
     async def h_devmem_report(self, conn, body):
         """Device-memory snapshot from a worker (util/devmem pools +
         per-device stats + compile observability), identity-joined here
@@ -3430,6 +3510,14 @@ class Head:
                     os.kill(worker.pid, 9)
                 except (ProcessLookupError, PermissionError):
                     pass
+            # The worker is doomed by OUR signal — process the death now
+            # instead of waiting for the connection EOF.  Otherwise a
+            # direct-call client whose peer connection broke first
+            # re-submits the in-flight call (retry budget already charged)
+            # and the resubmission races the EOF: dispatched to the
+            # still-registered dead worker, it dies with retries_left=0.
+            # The later EOF-driven death handler no-ops (worker popped).
+            await self._handle_worker_death(worker.worker_id)
         else:
             if actor.state != "DEAD":
                 actor.state = "DEAD"
@@ -4349,6 +4437,30 @@ class Head:
                     "engine": eid,
                     "latest": recs[-1] if recs else None,
                     "records": recs,
+                })
+            return {"items": items}
+        if kind == "gang_rounds":
+            # Gang observability view: one row per gang with its latest
+            # joined skew profile plus the retained profile window
+            # (optionally trimmed by ``limit`` and filtered by a ``gang``
+            # id prefix) and the newest raw record per rank.
+            gang = body.get("gang")
+            limit = int(body.get("limit") or 0)
+            items = []
+            for gid, st in self.gang_rounds.items():
+                if gang and not gid.startswith(str(gang)):
+                    continue
+                profs = list(st["profiles"])
+                if limit > 0:
+                    profs = profs[-limit:]
+                items.append({
+                    "gang": gid,
+                    "world": st["world"],
+                    "last_t": st["last_t"],
+                    "latest": profs[-1] if profs else None,
+                    "profiles": profs,
+                    "ranks": {str(r): rec for r, rec in
+                              sorted(st["latest_by_rank"].items())},
                 })
             return {"items": items}
         if kind == "devmem":

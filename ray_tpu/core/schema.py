@@ -96,6 +96,9 @@ REQUIRED: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # Flight recorder: batched engine step records (each entry needs
     # engine/step; the handler skips malformed entries like span_batch).
     "engine_step_batch": (("steps", list),),
+    # Gang round flight recorder: batched per-rank training round records
+    # (each entry needs gang/rank/round; malformed entries are skipped).
+    "gang_round_batch": (("rounds", list),),
     # Device-memory accounting snapshot (util/devmem.py), shipped on the
     # worker's metrics cadence.
     "devmem_report": (("pid", _NUM), ("devmem", dict)),

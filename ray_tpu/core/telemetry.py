@@ -194,6 +194,12 @@ class HeadMetrics:
             "Head RPC handler wall time per method",
             boundaries=self._LATENCY_BOUNDS, tag_keys=("method",),
             register=False)
+        # -- gang training observability (h_gang_round_batch join) ------------
+        self.gang_round_skew = Histogram(
+            "ray_tpu_gang_round_skew_seconds",
+            "Per-round gang skew (straggler's lead over the median rank) "
+            "observed when a round joins across all ranks",
+            boundaries=self._LATENCY_BOUNDS, register=False)
         self._all = [
             self.submit_to_start, self.queue_depth, self.tasks_dispatched,
             self.task_duration, self.store_used, self.store_capacity,
@@ -201,7 +207,7 @@ class HeadMetrics:
             self.lease_revocations,
             self.head_restarts, self.headless_seconds, self.resync_reports,
             self.incidents_opened, self.incidents_resolved, self.loop_lag,
-            self.rpc_handler,
+            self.rpc_handler, self.gang_round_skew,
         ]
 
     def sample_store(self, stats: dict) -> None:

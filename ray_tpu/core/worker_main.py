@@ -1303,15 +1303,18 @@ class Worker:
                     stream.flush_residual()
             # Trailing spans (the final task's execution span lands in the
             # ring AFTER its task_done) must not die with the process.
+            from ..util import gangrec as _gangrec
             from ..util import steprec as _steprec
             from ..util import tracing as _tracing
 
             _tracing.flush_spans(self.client)
-            # Flight recorder: final step batch + a forced black-box dump
-            # (the sidecar next to the log file is what post-mortem tools
-            # read when the head never saw these records).
+            # Flight recorders: final step/round batches + forced black-box
+            # dumps (the sidecars next to the log file are what post-mortem
+            # tools read when the head never saw these records).
             _steprec.flush_steps(self.client)
             _steprec.dump_black_box(force=True)
+            _gangrec.flush_rounds(self.client)
+            _gangrec.dump_black_box(force=True)
             self.client._flush_submit_batch()
             from ray_tpu.util.metrics import _final_flush
 

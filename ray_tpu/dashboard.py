@@ -44,7 +44,7 @@ from typing import Optional
 _STATE_KINDS = (
     "nodes", "actors", "tasks", "workers", "objects",
     "placement_groups", "timeline", "metrics", "task_events", "logs",
-    "traces", "engine_steps", "devmem", "incidents",
+    "traces", "engine_steps", "gang_rounds", "devmem", "incidents",
 )
 
 _PAGE = """<!doctype html>
@@ -84,8 +84,8 @@ _PAGE = """<!doctype html>
 <script>
 const TABS = ["status","nodes","actors","tasks","workers","objects",
               "placement_groups","jobs","metrics","history","summary",
-              "task_events","logs","traces","engine_steps","devmem",
-              "incidents"];
+              "task_events","logs","traces","engine_steps","gang_rounds",
+              "devmem","incidents"];
 let tab = location.hash.slice(1) || "status";
 const nav = document.getElementById("nav");
 TABS.forEach(t => {

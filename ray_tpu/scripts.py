@@ -81,6 +81,7 @@ _LIST_COLUMNS = {
                     "error"],
     "incidents": ["id", "kind", "severity", "state", "fired_count",
                   "summary"],
+    "gang_rounds": ["gang", "world", "last_t", "latest"],
 }
 
 
@@ -197,6 +198,99 @@ def _engine_rows(engines, devmem_items) -> list:
     return rows
 
 
+def _gang_rows(items) -> list:
+    """Display rows for the gang skew join (shared by `gang` and `top`):
+    one line per gang with its latest joined round's wall/skew and
+    straggler attribution."""
+    rows = []
+    for g in items:
+        latest = g.get("latest") or {}
+        skew = latest.get("skew_s")
+        frac = latest.get("skew_frac")
+        rows.append({
+            "gang": g.get("gang", "?"),
+            "world": g.get("world", 0),
+            "round": latest.get("round", "-"),
+            "wall": f"{latest.get('wall_s', 0):.3f}s" if latest else "-",
+            "skew": f"{skew:.3f}s ({100 * frac:.0f}%)"
+            if isinstance(skew, (int, float)) else "-",
+            "straggler": f"r{latest.get('straggler')}:{latest.get('phase')}"
+            if latest.get("straggler") is not None else "-",
+            "data%": f"{100 * latest.get('data_frac', 0):.0f}"
+            if latest else "-",
+            "coll%": f"{100 * latest.get('coll_frac', 0):.0f}"
+            if latest else "-",
+            "mfu": f"{latest.get('mfu'):.3f}"
+            if isinstance(latest.get("mfu"), (int, float)) else "-",
+        })
+    return rows
+
+
+def cmd_gang(args) -> int:
+    """Gang training skew: per-round straggler attribution joined from the
+    per-rank round flight recorders.  Without an id, one summary line per
+    gang; with an id (prefix), the recent skew profiles plus the newest
+    raw record from every rank."""
+    import time as _time
+
+    cl = _client(args.address)
+    try:
+        body = {"kind": "gang_rounds", "limit": max(1, args.rounds)}
+        if args.gang:
+            body["gang"] = args.gang
+        items = cl.call("list_state", body)["items"]
+        if args.json:
+            print(json.dumps(items, indent=1, default=str))
+            return 0
+        if not items:
+            print(f"(no gang matching {args.gang!r})" if args.gang else
+                  "(no gang rounds joined yet — flight recorder off or no "
+                  "multi-rank train run)")
+            return 1 if args.gang else 0
+        if not args.gang:
+            _print_table(_gang_rows(items),
+                         ["gang", "world", "round", "wall", "skew",
+                          "straggler", "data%", "coll%", "mfu"])
+            return 0
+        now = _time.time()
+        for g in items:
+            print(f"gang {g.get('gang')}  world {g.get('world')}  "
+                  f"last seen {_age(now, g.get('last_t'))} ago")
+            ranks = g.get("ranks") or {}
+            rank_rows = [{
+                "rank": r, "round": rec.get("round"),
+                "wall": f"{rec.get('wall_s', 0):.3f}",
+                "data": f"{rec.get('data_s', 0):.3f}",
+                "coll": f"{rec.get('coll_s', 0):.3f}",
+                "ckpt": f"{rec.get('ckpt_s', 0):.3f}",
+                "ack": f"{rec.get('ack_s', 0):.3f}",
+                "mfu": f"{rec.get('mfu'):.3f}"
+                if isinstance(rec.get("mfu"), (int, float)) else "-",
+            } for r, rec in sorted(ranks.items(), key=lambda kv: int(kv[0]))]
+            _print_table(rank_rows,
+                         ["rank", "round", "wall", "data", "coll", "ckpt",
+                          "ack", "mfu"], empty="(no per-rank records)")
+            prof_rows = [{
+                "round": p.get("round"),
+                "wall": f"{p.get('wall_s', 0):.3f}",
+                "skew": f"{p.get('skew_s', 0):.3f}",
+                "skew%": f"{100 * p.get('skew_frac', 0):.0f}",
+                "straggler": f"r{p.get('straggler')}",
+                "phase": p.get("phase"),
+                "lag": f"{p.get('phase_lag_s', 0):.3f}",
+                "mfu": f"{p.get('mfu'):.3f}"
+                if isinstance(p.get("mfu"), (int, float)) else "-",
+            } for p in (g.get("profiles") or [])]
+            print()
+            _print_table(prof_rows,
+                         ["round", "wall", "skew", "skew%", "straggler",
+                          "phase", "lag", "mfu"],
+                         empty="(no joined rounds yet)")
+    finally:
+        cl.close()
+    return 0
+
+
 def _node_row(n: dict) -> dict:
     stats = n.get("stats") or {}
     mem = stats.get("mem_used_frac")
@@ -222,6 +316,7 @@ def _render_top(cl) -> str:
     engines = cl.call(
         "list_state", {"kind": "engine_steps", "limit": 64})["items"]
     devmem = cl.call("list_state", {"kind": "devmem"})["items"]
+    gangs = cl.call("list_state", {"kind": "gang_rounds", "limit": 1})["items"]
     alive = sum(1 for n in nodes if n.get("alive"))
     health = _health_line(cl)
     sections = [
@@ -243,6 +338,13 @@ def _render_top(cl) -> str:
                   "serve traffic yet)",
         ),
     ]
+    if gangs:
+        # Gang section only when a train gang is actually reporting —
+        # serve-only clusters keep the frame compact.
+        sections += ["", _format_table(
+            _gang_rows(gangs),
+            ["gang", "world", "round", "wall", "skew", "straggler",
+             "data%", "coll%", "mfu"])]
     return "\n".join(sections)
 
 
@@ -551,6 +653,20 @@ def cmd_doctor(args) -> int:
         if ev.get("step_window"):
             print("  step-record window: " + "  ".join(
                 f"{k}={v}" for k, v in ev["step_window"].items()))
+        if ev.get("gang"):
+            # Gang incident: rank/phase attribution from the skew join.
+            line = f"  gang {ev['gang']}"
+            if ev.get("rank") is not None:
+                line += f": straggler rank {ev['rank']}"
+            if ev.get("phase"):
+                line += f" late in {ev['phase']}"
+            for k in ("skew_frac", "data_frac", "coll_frac"):
+                if isinstance(ev.get(k), (int, float)):
+                    line += f"  {k}={ev[k]:g}"
+            print(line)
+            for wr in (ev.get("worst_rounds") or [])[:3]:
+                print("  worst round: " + "  ".join(
+                    f"{k}={v}" for k, v in wr.items() if v is not None))
         for h in ev.get("slowest_handlers") or []:
             print(f"  handler {h['method']}: {h['total_s']}s "
                   f"over {h['calls']} calls")
@@ -833,7 +949,7 @@ def main(argv=None) -> int:
     p.add_argument("kind", choices=[
         "actors", "tasks", "nodes", "workers", "objects",
         "placement_groups", "pgs", "logs", "task_events",
-        "engine_steps", "devmem", "incidents",
+        "engine_steps", "gang_rounds", "devmem", "incidents",
     ])
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_list)
@@ -900,6 +1016,18 @@ def main(argv=None) -> int:
     p.add_argument("--once", action="store_true",
                    help="render one frame and exit (scripts/CI)")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "gang",
+        help="gang training skew: per-round straggler attribution from "
+             "the rank flight recorders")
+    p.add_argument("gang", nargs="?", default=None,
+                   help="gang id (prefix ok) for the per-rank detail view; "
+                        "omit for one summary line per gang")
+    p.add_argument("--rounds", type=int, default=20,
+                   help="joined skew profiles to show per gang")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_gang)
 
     p = sub.add_parser(
         "profile",

@@ -21,6 +21,62 @@ from .checkpoint import Checkpoint
 
 _session: Optional["TrainSession"] = None
 
+_m_data_wait = None
+
+
+def _observe_data_wait(seconds: float) -> None:
+    """Rank-side data-wait histogram — lazily resolved so sessions built
+    directly in unit tests don't spin up the metrics registry."""
+    global _m_data_wait
+    try:
+        from ..util.metrics import get_histogram
+
+        if _m_data_wait is None:
+            _m_data_wait = get_histogram(
+                "ray_tpu_gang_data_wait_seconds",
+                "Per-round dataset wait observed by one gang rank")
+        _m_data_wait.observe(seconds)
+    except Exception:
+        pass  # metrics must never fail a training round
+
+
+class _TimedShard:
+    """Transparent dataset-shard proxy: times blocking iteration (and any
+    ``iter_batches`` stream) so report() can attribute the round's data
+    wait.  Everything else delegates to the wrapped shard."""
+
+    def __init__(self, shard, session: "TrainSession"):
+        self._shard = shard
+        self._session = session
+
+    def __getattr__(self, name):
+        return getattr(self._shard, name)
+
+    def __iter__(self):
+        return self._timed(iter(self._shard))
+
+    def iter_batches(self, *args, **kwargs):
+        return self._timed(self._shard.iter_batches(*args, **kwargs))
+
+    def _timed(self, it: Iterator):
+        import time as _time
+
+        from ..util import chaos as _chaos
+
+        s = self._session
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                s._data_wait_s += _time.perf_counter() - t0
+                return
+            # Chaos straggler injection ("data"): inside the timed window,
+            # so the injected delay is attributed as data wait.
+            _chaos.maybe_straggle("data", s.world_rank)
+            s._data_wait_s += _time.perf_counter() - t0
+            yield item
+
 
 class TrainSession:
     def __init__(
@@ -75,6 +131,17 @@ class TrainSession:
         # tests don't spin up the metrics flusher.
         self._telemetry = None
         self._last_report_t: Optional[float] = None
+        # Gang round flight recorder (util/gangrec.py): every report()
+        # appends ONE fixed-size record attributing the round across
+        # data / compute / collective / checkpoint / lockstep-ack, joined
+        # head-side by (gang, round) into skew profiles.  gang_id is set
+        # by WorkerGroup.setup (one id per gang incarnation); the phase
+        # accumulators are touched only by the train loop thread —
+        # report() is synchronous — so they need no lock.
+        self.gang_id: Optional[str] = None
+        self._data_wait_s = 0.0
+        self._coll_base: Optional[Dict[str, Any]] = None
+        self._compile_base = 0.0
 
     @property
     def telemetry(self):
@@ -143,11 +210,22 @@ class TrainSession:
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
+        import time as _time
+
+        from ..util import chaos as _chaos
+
+        # Chaos straggler injection ("compute"): a slowdown here is
+        # indistinguishable from a slow step body — it lands in the round
+        # record's compute residual.
+        _chaos.maybe_straggle("compute", self.world_rank)
         self.step += 1
         metrics = self._augment_metrics(dict(metrics))
         persisted = None
         replicated = False
+        ckpt_s = 0.0
         if checkpoint is not None:
+            ckpt_t0 = _time.perf_counter()
+            _chaos.maybe_straggle("checkpoint", self.world_rank)
             # Stage the worker's checkpoint under the trial dir so it outlives
             # the user's temp directory.
             dest = os.path.join(
@@ -167,6 +245,7 @@ class TrainSession:
                     replicated = True
                 except Exception:
                     pass  # replication is best-effort by design
+            ckpt_s = _time.perf_counter() - ckpt_t0
         else:
             drain_save = False
         self.result_queue.put(
@@ -176,12 +255,59 @@ class TrainSession:
         )
         # Lockstep with the driver (reference behavior: session.report blocks
         # until the round is processed).
+        ack_t0 = _time.perf_counter()
         self.consumed.acquire()
+        now = _time.perf_counter()
+        self._emit_round(metrics, ckpt_s=ckpt_s, ack_s=now - ack_t0)
         # Step time measures the user's loop body, not the driver's round
         # processing: restart the clock after the lockstep wait returns.
-        import time as _time
-
         self._last_report_t = _time.perf_counter()
+
+    def _emit_round(self, metrics: Dict[str, Any], *, ckpt_s: float,
+                    ack_s: float) -> None:
+        """Append this round's flight record (util/gangrec.py).  All the
+        goodput numbers come from the SAME telemetry.record_step sample
+        that _augment_metrics merged into the reported metrics — the round
+        record and the metrics history can never disagree.  Best-effort:
+        recording must never fail a training round."""
+        try:
+            import time as _time
+
+            from ..collective import collective as _coll
+            from ..util import gangrec
+
+            tel = self.telemetry.last
+            totals = _coll.op_totals()
+            base = self._coll_base or {"ops": 0, "wall_s": 0.0, "bytes": 0}
+            self._coll_base = totals
+            compile_total = float(getattr(
+                self._telemetry, "_compile_total", 0.0) or 0.0)
+            compile_s = max(0.0, compile_total - self._compile_base)
+            self._compile_base = compile_total
+            data_s, self._data_wait_s = self._data_wait_s, 0.0
+            rec = {
+                "gang": self.gang_id or self.collective_group or "local",
+                "rank": self.world_rank,
+                "world": self.world_size,
+                "round": self.step,
+                "t": _time.time(),
+                "wall_s": round(float(tel.get("step_time_s", 0.0)), 6),
+                "data_s": round(data_s, 6),
+                "coll_s": round(
+                    max(0.0, totals["wall_s"] - base["wall_s"]), 6),
+                "coll_bytes": max(0, totals["bytes"] - base["bytes"]),
+                "ack_s": round(ack_s, 6),
+                "ckpt_s": round(ckpt_s, 6),
+                "compile_s": round(compile_s, 6),
+                "tokens": metrics.get("tokens"),
+                "tps": tel.get("tokens_per_sec"),
+                "mfu": tel.get("mfu"),
+            }
+            gangrec.record_round(rec)
+            if data_s > 0:
+                _observe_data_wait(data_s)
+        except Exception:
+            pass
 
     def _augment_metrics(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
         """Derive goodput numbers for this report round.  Step time is the
@@ -213,7 +339,7 @@ class TrainSession:
         shard = self.dataset_shards.get(name)
         if shard is None:
             raise KeyError(f"no dataset shard named {name!r}")
-        return shard
+        return _TimedShard(shard, self)
 
     # ---- called from the actor's polling method -----------------------------
 

@@ -83,6 +83,10 @@ class TrainWorker:
             dataset_shards=dataset_shards,
         )
         self.session.collective_group = collective_group
+        # One gang id per WorkerGroup incarnation: the round flight
+        # recorder keys its records on it, so a restarted gang's rounds
+        # never join against the dead attempt's.
+        self.session.gang_id = gang_id or None
         if collective_group is not None:
             from ..collective import init_collective_group
 
@@ -136,22 +140,40 @@ class TrainWorker:
         """Execute the user train loop; always ends with a 'done' sentinel —
         including when the loop fails to even deserialize (the driver polls
         the session queue, so a raised-instead-of-queued error would hang it)."""
+        sentinel = {"done": True, "rank": self.rank}
         try:
             fn = cloudpickle.loads(fn_blob)
             if config is not None:
                 fn(config)
             else:
                 fn()
-            self.session.result_queue.put({"done": True, "rank": self.rank})
         except BaseException as e:  # noqa: BLE001 — relayed to the driver
             import traceback
 
-            self.session.result_queue.put({
-                "done": True, "rank": self.rank,
-                "error": f"{e}\n{traceback.format_exc()}",
-            })
-        finally:
-            self.session.finished = True
+            sentinel["error"] = f"{e}\n{traceback.format_exc()}"
+        self.session.finished = True
+        # Ship the tail of the round flight recorder BEFORE the done
+        # sentinel, synchronously: the driver tears the gang down the
+        # moment every loop reports done — faster than the client's 0.5s
+        # flush cadence AND faster than a fire-and-forget batch drains —
+        # so the last rounds of every run would otherwise only survive
+        # in the black box.
+        try:
+            from ..util import gangrec
+
+            gangrec.flush_rounds(sync=True)
+        except Exception:
+            pass
+        # Same race for the final metrics window: collective-op timings
+        # and recorder counters incremented during the last rounds must
+        # not die with the actor (bounded: drain_bg times out at 2s).
+        try:
+            from ..util.metrics import _final_flush
+
+            _final_flush()
+        except Exception:
+            pass
+        self.session.result_queue.put(sentinel)
 
     def poll(self, timeout: float = 600.0):
         return self.session.next_result(timeout=timeout)

@@ -277,6 +277,132 @@ class HeadKillInjector:
         self.stop()
 
 
+class StragglerSchedule:
+    """A parsed, seeded slow-rank schedule (the training-plane analogue of
+    util/netfault.py's FaultSchedule): ONE gang rank — chosen by the seed —
+    runs a fixed per-phase delay inside an arm-relative time window, and
+    every other rank runs clean.  The gang observability plane must then
+    name that rank (and the injected phase) in its straggler incident, and
+    the incident must resolve once the window closes.
+
+    Spec DSL — ``key=val`` pairs, comma-separated::
+
+        phase=data,ms=300,ranks=4,dur=6      # seeded rank of 4, +300ms per
+                                             # data fetch, for 6s from arm
+        phase=compute,ms=150,rank=2          # explicit rank, no window
+
+    Keys: ``phase`` is the training phase to slow (``data`` — inside the
+    dataset-shard iterator, ``compute`` — at report() entry, ``checkpoint``
+    — inside checkpoint staging).  ``ms`` is the added delay per injection
+    point.  ``ranks`` is the gang world size the seeded rank is drawn from
+    (``rank=`` pins it explicitly instead).  ``at``/``dur`` bound the
+    schedule to an arm-relative window (seconds) — a bounded window is how
+    chaos tests assert the incident RESOLVES after heal.
+
+    Armed two ways, mirroring netfault: ``RT_CHAOS_STRAGGLER`` +
+    ``RT_CHAOS_SEED`` in the environment (children inherit, so one export
+    covers a spawned gang) or :func:`arm_straggler` in-process.  Zero
+    overhead when off: the injection sites check one module global against
+    ``None`` and touch nothing else.
+    """
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = int(seed)
+        self.phase = "data"
+        self.ms = 100.0
+        self.at = 0.0
+        self.dur: Optional[float] = None
+        rank: Optional[int] = None
+        ranks = 1
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            if key == "phase":
+                if val not in ("data", "compute", "checkpoint"):
+                    raise ValueError(
+                        f"straggler: unknown phase {val!r} "
+                        "(data|compute|checkpoint)")
+                self.phase = val
+            elif key == "ms":
+                self.ms = float(val)
+            elif key == "rank":
+                rank = int(val)
+            elif key == "ranks":
+                ranks = int(val)
+            elif key == "at":
+                self.at = float(val)
+            elif key == "dur":
+                self.dur = float(val)
+            else:
+                raise ValueError(f"straggler: unknown spec key {key!r}")
+        # Seeded rank choice — chaos_soak rotates the seed so every soak
+        # iteration slows a different rank, and a failure replays from the
+        # printed seed.
+        self.rank = rank if rank is not None \
+            else random.Random(self.seed).randrange(max(1, ranks))
+        self._t0 = time.monotonic()
+        self.delays = 0  # injections performed (assertion hook)
+
+    def delay_s(self, phase: str, rank: int) -> float:
+        if rank != self.rank or phase != self.phase:
+            return 0.0
+        t = time.monotonic() - self._t0
+        if t < self.at or (self.dur is not None and t >= self.at + self.dur):
+            return 0.0
+        return self.ms / 1000.0
+
+    def describe(self) -> str:
+        win = "" if self.dur is None else f" at={self.at} dur={self.dur}"
+        return (f"straggler rank={self.rank} phase={self.phase} "
+                f"ms={self.ms:g}{win}")
+
+
+_straggler: Optional[StragglerSchedule] = None
+_straggler_env_checked = False
+
+
+def arm_straggler(spec: str, seed: int = 0) -> StragglerSchedule:
+    """Arm a straggler schedule in THIS process (tests; env arming covers
+    spawned ranks).  Replaces any armed schedule; returns it for
+    assertions."""
+    global _straggler
+    _straggler = StragglerSchedule(spec, seed)
+    print(f"chaos: armed {_straggler.describe()} seed={seed}", flush=True)
+    return _straggler
+
+
+def disarm_straggler() -> None:
+    global _straggler
+    _straggler = None
+
+
+def maybe_straggle(phase: str, rank: int) -> float:
+    """Injection hook the train session's phase paths call.  Sleeps the
+    scheduled delay when THIS (rank, phase) is the victim inside the arm
+    window; free when nothing is armed (one global None-check after the
+    lazy one-time env probe)."""
+    global _straggler, _straggler_env_checked
+    s = _straggler
+    if s is None:
+        if _straggler_env_checked:
+            return 0.0
+        _straggler_env_checked = True
+        spec = os.environ.get("RT_CHAOS_STRAGGLER")
+        if not spec:
+            return 0.0
+        s = _straggler = StragglerSchedule(
+            spec, int(os.environ.get("RT_CHAOS_SEED", "0") or 0))
+        print(f"chaos: armed {s.describe()} (env)", flush=True)
+    d = s.delay_s(phase, rank)
+    if d > 0:
+        s.delays += 1
+        time.sleep(d)
+    return d
+
+
 def run_under_chaos(fn, *, interval_s: float = 0.5, timeout_s: float = 60.0,
                     seed: int = 0):
     """Run ``fn()`` while a WorkerKiller fires; returns (result, kills).

@@ -79,6 +79,24 @@ DEFAULTS: Dict[str, Any] = {
     # Head self-observability.
     "loop_lag_warn_s": 0.5,
     "loop_lag_crit_s": 2.0,
+    # Gang training plane (joined round skew profiles, util/gangrec.py).
+    # Persistent straggler: the SAME rank arrives last in >= frac of the
+    # windowed rounds AND its median skew is a meaningful fraction of the
+    # round wall (absolute thresholds would be workload-dependent).
+    "straggler_min_rounds": 6,
+    "straggler_frac": 0.5,
+    "straggler_skew_frac": 0.2,
+    "straggler_skew_crit_frac": 1.0,  # skew >= the whole median wall
+    # Data starvation: the gang's mean data wait dominates the round.
+    "data_starved_frac": 0.5,
+    "data_min_rounds": 6,
+    # Collective desync/timeout suspicion: collective waits dominate the
+    # round — some rank is late to (or wedged in) every op.
+    "coll_desync_frac": 0.6,
+    "coll_min_rounds": 6,
+    # Trailing-window MFU regression: recent-half mean vs first-half mean.
+    "mfu_drop_frac": 0.2,
+    "mfu_min_rounds": 12,
 }
 
 
@@ -363,6 +381,164 @@ def detect_head_pressure(loop_lag: SeriesWindow, now: float,
         max_lag_s=round(worst, 4))]
 
 
+def _profiles_by_gang(profiles: List[dict], now: float,
+                      window_s: float) -> Dict[str, List[dict]]:
+    by: Dict[str, List[dict]] = {}
+    for pr in profiles or []:
+        ts = pr.get("t")
+        if isinstance(ts, (int, float)) and ts >= now - window_s:
+            by.setdefault(str(pr.get("gang", "?")), []).append(pr)
+    return by
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    if not s:
+        return 0.0
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def detect_gang_straggler(profiles: List[dict], now: float, window_s: float,
+                          params: Optional[dict] = None) -> List[dict]:
+    """Persistent straggler per gang, from joined round skew profiles
+    (util/gangrec.skew_profile rows, each carrying t/gang/round/straggler/
+    skew_s/wall_s/phase): fires when the SAME rank arrives last in >=
+    ``straggler_frac`` of the windowed rounds AND its median skew is >=
+    ``straggler_skew_frac`` of the median round wall.  A round-robin of
+    slow ranks (ordinary jitter) never fires — that is the point of the
+    dominance test."""
+    p = _params(params)
+    out = []
+    for gang, prs in _profiles_by_gang(profiles, now, window_s).items():
+        if len(prs) < p["straggler_min_rounds"]:
+            continue
+        counts: Dict[Any, int] = {}
+        for pr in prs:
+            r = pr.get("straggler")
+            if r is not None:
+                counts[r] = counts.get(r, 0) + 1
+        if not counts:
+            continue
+        rank, n = max(counts.items(), key=lambda kv: kv[1])
+        if n / len(prs) < p["straggler_frac"]:
+            continue
+        mine = [pr for pr in prs if pr.get("straggler") == rank]
+        med_skew = _median([float(pr.get("skew_s", 0.0)) for pr in mine])
+        med_wall = _median([float(pr.get("wall_s", 0.0)) for pr in prs])
+        if med_wall <= 0 or med_skew / med_wall < p["straggler_skew_frac"]:
+            continue
+        phases: Dict[str, int] = {}
+        for pr in mine:
+            ph = str(pr.get("phase") or "?")
+            phases[ph] = phases.get(ph, 0) + 1
+        phase = max(phases.items(), key=lambda kv: kv[1])[0]
+        worst = sorted(mine, key=lambda pr: -float(pr.get("skew_s", 0.0)))[:3]
+        sev = SEV_CRIT if med_skew / med_wall >= \
+            p["straggler_skew_crit_frac"] else SEV_WARN
+        out.append(firing(
+            "gang_straggler", f"gang_straggler:{gang}", sev,
+            f"gang {gang} rank {rank} straggled in {n}/{len(prs)} rounds "
+            f"(median skew {med_skew * 1e3:.0f}ms = "
+            f"{med_skew / med_wall:.0%} of median round wall; "
+            f"slow phase: {phase})",
+            gang=gang, rank=rank, phase=phase,
+            skew_frac=round(med_skew / med_wall, 3),
+            median_skew_s=round(med_skew, 6), rounds=len(prs),
+            straggler_rounds=n,
+            worst_rounds=[{
+                "round": pr.get("round"), "skew_s": pr.get("skew_s"),
+                "phase": pr.get("phase"), "wall_s": pr.get("wall_s"),
+            } for pr in worst]))
+    return out
+
+
+def detect_gang_data_starvation(profiles: List[dict], now: float,
+                                window_s: float,
+                                params: Optional[dict] = None) -> List[dict]:
+    """Data-starvation pressure per gang: the gang's mean data-wait
+    fraction (profile ``data_frac``) stays above threshold — the input
+    pipeline, not compute, is pacing the whole gang."""
+    p = _params(params)
+    out = []
+    for gang, prs in _profiles_by_gang(profiles, now, window_s).items():
+        fracs = [float(pr["data_frac"]) for pr in prs
+                 if isinstance(pr.get("data_frac"), (int, float))]
+        if len(fracs) < p["data_min_rounds"]:
+            continue
+        med = _median(fracs)
+        if med < p["data_starved_frac"]:
+            continue
+        out.append(firing(
+            "gang_data_starvation", f"gang_data_starvation:{gang}", SEV_WARN,
+            f"gang {gang} spent a median {med:.0%} of each round waiting "
+            f"on data over {len(fracs)} rounds — input pipeline is pacing "
+            "the gang",
+            gang=gang, data_frac=round(med, 3), rounds=len(fracs)))
+    return out
+
+
+def detect_gang_collective_desync(profiles: List[dict], now: float,
+                                  window_s: float,
+                                  params: Optional[dict] = None
+                                  ) -> List[dict]:
+    """Collective desync / timeout suspicion per gang: collective waits
+    (profile ``coll_frac``) dominate the round — ranks spend the round
+    parked inside allreduce/barrier waiting for a late or wedged peer.
+    Corroborate with the straggler incident (same window) to name it."""
+    p = _params(params)
+    out = []
+    for gang, prs in _profiles_by_gang(profiles, now, window_s).items():
+        fracs = [float(pr["coll_frac"]) for pr in prs
+                 if isinstance(pr.get("coll_frac"), (int, float))]
+        if len(fracs) < p["coll_min_rounds"]:
+            continue
+        med = _median(fracs)
+        if med < p["coll_desync_frac"]:
+            continue
+        out.append(firing(
+            "gang_collective_desync", f"gang_collective_desync:{gang}",
+            SEV_WARN,
+            f"gang {gang} spent a median {med:.0%} of each round inside "
+            f"collective waits over {len(fracs)} rounds — desync or "
+            "timeout suspicion",
+            gang=gang, coll_frac=round(med, 3), rounds=len(fracs)))
+    return out
+
+
+def detect_gang_mfu_regression(profiles: List[dict], now: float,
+                               window_s: float,
+                               params: Optional[dict] = None) -> List[dict]:
+    """Trailing-window MFU regression per gang: the recent half of the
+    window's mean MFU dropped >= ``mfu_drop_frac`` below the first
+    half's.  Catches slow degradation (thermal throttling, a recovering
+    rank on cold caches) that per-round skew never trips."""
+    p = _params(params)
+    out = []
+    for gang, prs in _profiles_by_gang(profiles, now, window_s).items():
+        seq = sorted(
+            (pr for pr in prs if isinstance(pr.get("mfu"), (int, float))),
+            key=lambda pr: pr.get("round") or 0)
+        if len(seq) < p["mfu_min_rounds"]:
+            continue
+        half = len(seq) // 2
+        base = sum(float(pr["mfu"]) for pr in seq[:half]) / half
+        recent = sum(float(pr["mfu"]) for pr in seq[half:]) \
+            / (len(seq) - half)
+        if base <= 0:
+            continue
+        drop = 1.0 - recent / base
+        if drop < p["mfu_drop_frac"]:
+            continue
+        out.append(firing(
+            "gang_mfu_regression", f"gang_mfu_regression:{gang}", SEV_WARN,
+            f"gang {gang} MFU regressed {drop:.0%} over the trailing "
+            f"window ({base:.3f} -> {recent:.3f} across {len(seq)} rounds)",
+            gang=gang, mfu_base=round(base, 4), mfu_recent=round(recent, 4),
+            drop_frac=round(drop, 3), rounds=len(seq)))
+    return out
+
+
 # --------------------------------------------------------------- incidents
 
 
@@ -490,6 +666,7 @@ _FAULT_COUNTERS = {
 _DROP_COUNTERS = {
     "ray_tpu_spans_dropped_total": "spans",
     "ray_tpu_step_records_dropped_total": "step_records",
+    "ray_tpu_gang_rounds_dropped_total": "gang_rounds",
     "ray_tpu_logs_dropped_total": "logs",
 }
 
@@ -562,7 +739,8 @@ class HealthEngine:
     def tick(self, now: float, rows: List[dict], steps: List[dict],
              devmem: Dict[str, dict], loop_lag_s: float,
              slo_targets: Optional[Dict[str, float]] = None,
-             evidence: Optional[Callable[[dict, float], dict]] = None
+             evidence: Optional[Callable[[dict, float], dict]] = None,
+             gang_profiles: Optional[List[dict]] = None
              ) -> List[dict]:
         """One detector pass; returns incidents opened this pass."""
         self.last_tick = now
@@ -596,6 +774,12 @@ class HealthEngine:
         firings += detect_drop_pressure(self._drops, now, w, p)
         firings += detect_devmem_leak(self._pools, now, max(w * 4, 60.0), p)
         firings += detect_head_pressure(self._loop_lag, now, w, p)
+        if gang_profiles:
+            firings += detect_gang_straggler(gang_profiles, now, w, p)
+            firings += detect_gang_data_starvation(gang_profiles, now, w, p)
+            firings += detect_gang_collective_desync(
+                gang_profiles, now, w, p)
+            firings += detect_gang_mfu_regression(gang_profiles, now, w, p)
         return self.manager.observe(firings, now, evidence)
 
     @staticmethod

@@ -105,6 +105,15 @@ BUILTIN_METRICS: Dict[str, str] = {
     "ray_tpu_incidents_resolved_total": "counter",
     "ray_tpu_head_loop_lag_seconds": "gauge",
     "ray_tpu_head_rpc_handler_seconds": "histogram",
+    # gang training observability (util/gangrec.py ring; train/session.py
+    # round records; collective/collective.py per-op timing;
+    # core/head.py h_gang_round_batch joins)
+    "ray_tpu_gang_rounds_flushed_total": "counter",
+    "ray_tpu_gang_rounds_dropped_total": "counter",
+    "ray_tpu_gang_round_skew_seconds": "histogram",
+    "ray_tpu_gang_data_wait_seconds": "histogram",
+    "ray_tpu_collective_op_seconds": "histogram",
+    "ray_tpu_collective_bytes_total": "counter",
     # put-path contention accounting (core/object_store.py stages + lock
     # waits; core/rpc.py outbox queue delay)
     "ray_tpu_store_lock_wait_seconds": "histogram",

@@ -10,6 +10,7 @@
 #   scripts/chaos_soak.sh --race-sentinel [N]
 #   scripts/chaos_soak.sh --head-kill [N]   # head SIGKILL+restart subset only
 #   scripts/chaos_soak.sh --netfault [N]    # network fault-injection subset
+#   scripts/chaos_soak.sh --straggler [N]   # gang-straggler drill only
 #   CHAOS_PYTEST_ARGS="-k drain" scripts/chaos_soak.sh 10
 #
 # Rotating seeds: each iteration exports RT_CHAOS_SEED=<iter>, which the
@@ -33,6 +34,13 @@
 # an external head is SIGKILLed mid-workload and restarted with the same
 # port/session/state; the pass criteria are zero failed direct calls,
 # full field-state resync, and the headless suicide deadline.
+#
+# --straggler soaks the gang-straggler drill (tests/test_gang_obs.py
+# -m chaos): a seeded util/chaos StragglerSchedule slows ONE rank's data
+# phase, and the pass criteria are exactly one gang_straggler incident
+# naming the seeded rank + phase (with worst-round evidence and linked
+# traces), then resolution after the run ends.  Rotating RT_CHAOS_SEED
+# rotates the victim rank, so a soak sweeps detection across ranks.
 set -u -o pipefail
 
 LOCKS_LEVEL="${RT_DEBUG_LOCKS:-0}"
@@ -42,6 +50,7 @@ while [ $# -gt 0 ]; do
         --race-sentinel) LOCKS_LEVEL=2; shift ;;
         --head-kill) MODE="head-kill"; shift ;;
         --netfault) MODE="netfault"; shift ;;
+        --straggler) MODE="straggler"; shift ;;
         *) break ;;
     esac
 done
@@ -56,6 +65,12 @@ elif [ "$MODE" = "netfault" ]; then
     # mode: a seeded partition under live traffic must open >=1
     # partition-suspicion incident (with evidence) and resolve after heal.
     TARGETS="tests/test_netfault.py tests/test_health.py"
+    MARK="chaos"
+elif [ "$MODE" = "straggler" ]; then
+    # The seeded-straggler drill: each seed picks a different victim
+    # rank (random.Random(seed).randrange(world)), so the soak sweeps
+    # the skew-join + detector + doctor path across every rank.
+    TARGETS="tests/test_gang_obs.py"
     MARK="chaos"
 else
     TARGETS="tests/test_fault_tolerance.py tests/test_chaos.py tests/test_head_crash.py"
@@ -105,5 +120,19 @@ if [ "$MODE" = "netfault" ]; then
         exit 1
     fi
     echo "netfault false-positive gate: clean (zero incidents)"
+fi
+
+if [ "$MODE" = "straggler" ]; then
+    # False-positive gate: an uninjected gang must open ZERO gang_*
+    # incidents — the dominance test exists so ordinary round jitter
+    # never pages.
+    echo "=== straggler false-positive gate (clean gang, no injection) ==="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m pytest -q \
+        tests/test_gang_obs.py::test_clean_gang_joins_profiles_and_opens_no_incidents \
+        -p no:cacheprovider -p no:randomly; then
+        echo "!!! false-positive gate: clean gang opened incidents"
+        exit 1
+    fi
+    echo "straggler false-positive gate: clean (zero gang incidents)"
 fi
 echo "chaos soak: $N/$N iterations green"

@@ -42,5 +42,9 @@ if [ "$rc" -ne 0 ]; then
     # pressure, SLO burn) faster than the raw log tails do.
     echo "=== open incidents (health plane) ==="
     python -m ray_tpu incidents 2>/dev/null || true
+    # Gang skew snapshot: a hung/failed train test usually shows up here
+    # as a straggling rank or a round that never joined.
+    echo "=== gang round skew (train plane) ==="
+    python -m ray_tpu gang 2>/dev/null || true
 fi
 exit "$rc"
