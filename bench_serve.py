@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -556,6 +557,13 @@ def main(argv=None) -> Dict:
                     help="artifact path (default BENCH_SERVE.json unless "
                          "--smoke)")
     args = ap.parse_args(argv)
+
+    if args.smoke:
+        # Smoke mode doubles as the recompile gate: every engine warmup
+        # below arms the sentinel (devtools.jitguard), so a post-warmup
+        # retrace of any paged program aborts the bench with the arg
+        # delta instead of quietly skewing the numbers.
+        os.environ.setdefault("RT_DEBUG_JIT", "1")
 
     n_cap = 24 if args.smoke else 64
     n_row = 16 if args.smoke else 64

@@ -600,7 +600,7 @@ class Client:
             reply = self.call(
                 "get_objects",
                 {"object_ids": [o.binary() for o in object_ids], "timeout": timeout},
-                timeout=1e9 if timeout < 0 else timeout + 30,
+                timeout=None if timeout < 0 else timeout + 30,
             )
         return reply["objects"]
 
@@ -668,17 +668,21 @@ class Client:
         """Every known copy of the object is gone: ask the head to recompute
         it from lineage, then wait for the re-seal and re-read (reference:
         object_recovery_manager.h:90)."""
-        deadline = None if timeout < 0 else time.monotonic() + timeout
+        from . import deadline as _dl
+
+        deadline = None if timeout < 0 else _dl.Deadline.after(timeout)
+        # The sole-copy node may be dead but not yet declared (its head
+        # connection can linger); back off between attempts so the health
+        # prober has time to reap it and the head drops the stale location.
+        backoff = _dl.BackoffPolicy(base_s=0.5, multiplier=2.0, cap_s=2.0,
+                                    jitter=0.0)
         for attempt in range(3):
             if attempt:
-                # The sole-copy node may be dead but not yet declared (its
-                # head connection can linger); give the health prober time
-                # to reap it so the head drops the stale location.
-                time.sleep(0.5 * (2 ** (attempt - 1)))
+                backoff.sleep(attempt, deadline)
             self.call("reconstruct_object", {"object_id": oid.binary()})
             remaining = (
                 -1.0 if deadline is None
-                else max(0.0, deadline - time.monotonic())
+                else max(0.0, deadline.remaining())
             )
             desc = self.get_raw([oid], remaining)[0]
             if desc.get("timeout"):
@@ -981,7 +985,7 @@ class Client:
                     "num_returns": num_returns,
                     "timeout": timeout,
                 },
-                timeout=1e9 if timeout < 0 else timeout + 30,
+                timeout=None if timeout < 0 else timeout + 30,
             )
         return set(reply["ready"])
 
@@ -1036,9 +1040,11 @@ class Client:
             if reply is not None:
                 return reply
         with self._maybe_blocked():
+            # Streams have no per-item budget: the producer paces the
+            # consumer, so this read legitimately waits forever.
             return self.rpc.call(
                 "next_stream_item", {"task_id": task_id, "index": index},
-                timeout=1e9,
+                timeout=None,
             )
 
     # -- KV --------------------------------------------------------------------
@@ -1091,7 +1097,7 @@ class Client:
 
     # -- passthrough -----------------------------------------------------------
 
-    def call(self, method: str, body=None, timeout: float = 60.0):
+    def call(self, method: str, body=None, timeout: Optional[float] = 60.0):
         self.check_bg()
         self._flush_put_batch()
         self._flush_submit_batch()

@@ -85,6 +85,10 @@ SPAWN_ENV_CONTRACT = {
                       "guard-map race sentinel (devtools.locks)",
     "RT_DEBUG_LOCKS_HOLD_S": "long-hold warning threshold for the lock "
                              "sentinel",
+    "RT_DEBUG_JIT": "recompile sentinel: after the engine/learner warmup "
+                    "arms it, any post-warmup retrace of a registered jit "
+                    "program raises RecompileError with the arg "
+                    "shape/dtype delta (devtools.jitguard)",
     "RT_NATIVE_SANITIZE": "build the _native helper with a sanitizer",
 }
 

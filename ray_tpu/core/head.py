@@ -3721,7 +3721,7 @@ class Head:
                     break
                 if w.state != IDLE or not w.conn.alive or not w.peer_addr:
                     continue
-                if not self.scheduler.lease_slot(w.node_id, resources):
+                if not self.scheduler.lease_slot(w.node_id, resources):  # rt-owns: sched_slot
                     continue
                 lease_id = os.urandom(8)
                 self.leases[lease_id] = {

@@ -520,7 +520,11 @@ class RpcClient:
         except Exception:
             pass  # read loop's teardown already failed the pending future
 
-    def call(self, method: str, body: Any = None, timeout: float = 60.0) -> Any:
+    def call(self, method: str, body: Any = None,
+             timeout: Optional[float] = 60.0) -> Any:
+        """Blocking request/reply.  ``timeout=None`` waits forever — the
+        caller owns its own deadline (e.g. a ``get(timeout=-1)`` that is
+        contractually infinite); prefer that over sentinel constants."""
         if self.closed:
             raise ConnectionLost("client is closed")
         fut = self.call_async(method, body)

@@ -1,0 +1,185 @@
+"""Runtime recompile sentinel: a trace-count registry for jitted programs.
+
+The serving/training perf story rests on ONE compiled program per hot
+path: the engine asserts ``decode_traces == 1`` after warmup, and the
+flight recorder attributes any step that paid a compile.  Those counters
+used to live in ``models/paged.py``; this module generalizes them into a
+registry ANY module can join (the paged programs, the rllib learner
+updates, future kernels) and adds the dynamic twin of rtlint RT010 —
+sibling of :mod:`devtools.locks` (RT_DEBUG_LOCKS):
+
+- **disabled** (default): :func:`bump` is a plain counter increment at
+  trace time — exactly the old ``models.paged._bump`` behavior, zero
+  added work on any jitted call (python bodies only run while tracing).
+- **enabled** (``RT_DEBUG_JIT=1``): after :func:`arm` (the engine calls
+  it at the end of ``warmup()``; tests/bench can call it directly), any
+  growth in an armed program's trace count raises
+  :class:`RecompileError` naming the program, the argument
+  treeshape/dtype delta versus the last trace, and the call site that
+  triggered the recompile — the steady-state loop fails loudly at the
+  FIRST stray specialization instead of silently paying a compile per
+  step.
+
+Programs join by bumping inside their jitted body::
+
+    @jax.jit
+    def step(xs):
+        jitguard.bump("step", jitguard.signature_of({"xs": xs}))
+        ...
+
+``models.paged`` keeps its old ``trace_count``/``trace_counts`` names as
+aliases over this registry, so ``devmem`` snapshots and the engine's
+``decode_traces`` assertions are unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+ENV_FLAG = "RT_DEBUG_JIT"
+
+
+class RecompileError(RuntimeError):
+    """An armed program re-traced after warmup — some argument's
+    treeshape/dtype/static value drifted and XLA compiled a new
+    specialization on the hot path."""
+
+
+def enabled() -> bool:
+    """Sentinel armed-on-arm()?  Off means :func:`arm` is a no-op and
+    :func:`bump` stays the identity counter path."""
+    return os.environ.get(ENV_FLAG, "") in ("1", "true", "yes")
+
+
+# Registry state.  Locked: learner updates and the engine loop may trace
+# on different threads.  Bumps happen only at TRACE time, never per step.
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+_sigs: Dict[str, Any] = {}          # program -> last traced signature
+_baseline: Dict[str, int] = {}      # armed program -> count at arm()
+
+
+def reset_sentinel_state() -> None:
+    """Forget every count, signature, and armed baseline (tests)."""
+    with _lock:
+        _counts.clear()
+        _sigs.clear()
+        _baseline.clear()
+
+
+def register_program(name: str) -> None:
+    """Declare a program.  Registration before the first trace makes it
+    visible in :func:`counts` snapshots at 0.  Re-registering an ARMED
+    program stands its baseline down until the next :func:`arm` —
+    building a new component that shares the program (a fresh engine,
+    adapter pool, or learner) opens a legitimate compile phase, not a
+    hot-path recompile."""
+    with _lock:
+        _counts.setdefault(name, 0)
+        _baseline.pop(name, None)
+
+
+def count(name: str) -> int:
+    """Times the named program was traced (compiled)."""
+    return _counts.get(name, 0)
+
+
+def counts() -> Dict[str, int]:
+    """Snapshot of every registered program's trace count."""
+    with _lock:
+        return dict(_counts)
+
+
+def signature_of(arrays: Dict[str, Any]) -> Dict[str, Any]:
+    """Treeshape/dtype signature of named (tracer or concrete) arrays —
+    what the jitted body passes to :func:`bump` so a post-warmup
+    recompile can say WHICH argument drifted."""
+    out: Dict[str, Any] = {}
+    for k, v in arrays.items():
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is not None:
+            out[k] = (tuple(shape), str(dtype))
+        else:
+            out[k] = f"{type(v).__name__}:{v!r}"[:80]
+    return out
+
+
+def _delta(old: Optional[Dict[str, Any]],
+           new: Optional[Dict[str, Any]]) -> str:
+    if not isinstance(old, dict) or not isinstance(new, dict):
+        return f"prev={old!r} now={new!r}"
+    parts = []
+    for k in sorted(set(old) | set(new)):
+        a, b = old.get(k), new.get(k)
+        if a != b:
+            parts.append(f"{k}: {a!r} -> {b!r}")
+    return "; ".join(parts) if parts else "identical visible signature " \
+        "(a static arg or closure constant changed)"
+
+
+def _call_site() -> str:
+    """The deepest non-jax, non-jitguard project frame below us — the
+    call that triggered this trace (the traced body's own frame is the
+    one directly above bump; its CALLER past the jax machinery is what
+    an operator can go fix)."""
+    frames = [f for f in traceback.extract_stack()
+              if f.filename != __file__
+              and "/jax/" not in f.filename.replace("\\", "/")
+              and "jax/_src" not in f.filename]
+    # frames[-1] is the traced body; the next project frame up is the
+    # call site.  A direct call (tests) leaves only the body.
+    if len(frames) >= 2:
+        f = frames[-2]
+    elif frames:
+        f = frames[-1]
+    else:
+        return "<unknown>"
+    return f"{f.filename}:{f.lineno} in {f.name}"
+
+
+def bump(name: str, signature: Optional[Dict[str, Any]] = None) -> None:
+    """Record one trace of ``name``.  Called INSIDE jitted bodies (python
+    executes only while tracing, so a bump == a compile).  When the
+    sentinel is armed and this program's baseline is exceeded, raise
+    :class:`RecompileError` with the signature delta and call site."""
+    with _lock:
+        n = _counts.get(name, 0) + 1
+        _counts[name] = n
+        prev_sig = _sigs.get(name)
+        if signature is not None:
+            _sigs[name] = signature
+        baseline = _baseline.get(name)
+    if baseline is not None and n > baseline:
+        raise RecompileError(
+            f"program {name!r} recompiled after warmup (trace "
+            f"{n} > armed baseline {baseline}): arg delta "
+            f"[{_delta(prev_sig, signature)}] — triggered at "
+            f"{_call_site()}"
+        )
+
+
+def arm(force: bool = False) -> bool:
+    """Freeze every currently-registered program's trace count as its
+    baseline.  No-op (returns False) unless ``RT_DEBUG_JIT=1`` or
+    ``force`` — the disabled path stays the identity counter.  Programs
+    registered AFTER arming are unarmed until the next :func:`arm` (a
+    late-joining learner must get its own warmup trace)."""
+    if not (enabled() or force):
+        return False
+    with _lock:
+        _baseline.clear()
+        _baseline.update(_counts)
+    return True
+
+
+def disarm() -> None:
+    with _lock:
+        _baseline.clear()
+
+
+def armed() -> bool:
+    return bool(_baseline)

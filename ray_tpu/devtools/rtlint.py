@@ -34,6 +34,23 @@ RT008   static lock-order cycle: nested ``with lock:`` scopes composed
 RT009   spawn-env contract drift: ad-hoc ``RT_*`` ``os.environ`` reads vs
         the ``SPAWN_ENV_CONTRACT`` catalog in ``core/config.py``
         (missing/stale/orphan-write, plus reads shadowing Config fields)
+RT010   JAX hot-path hazards: recompile triggers (jit-in-loop defs,
+        unhashable static args), implicit host syncs (``.item()`` /
+        ``float()`` / ``np.asarray`` on jit outputs) inside the step
+        loops, and donated buffers read after the donating call —
+        vetted per-line with ``# rt-sync-ok: <reason>``; the runtime
+        half is the ``RT_DEBUG_JIT=1`` recompile sentinel
+        (``devtools.jitguard``)
+RT011   resource-lifecycle leaks over the declared acquire/release pair
+        catalog (page alloc/free, adapter pin/release, prefix claims,
+        scheduler leases): leaks on normal and exception exits, double
+        releases, releases of never-acquired names — ownership
+        transfers annotated ``# rt-owns: <pair>``
+RT012   deadline-contract drift: hand-rolled retry curves instead of
+        ``core.deadline.BackoffPolicy``, unbounded ``while True``
+        re-dial loops with no ``Deadline``, and sentinel
+        ``timeout=1e9``-style constants — vetted per-line with
+        ``# rt-deadline-ok: <reason>``
 ======  =====================================================================
 
 Vetted exceptions live in ``ray_tpu/.rtlint-allowlist`` (shipped as
@@ -212,7 +229,8 @@ def apply_allowlist(
 
 def all_rules():
     from . import (rules_api, rules_async, rules_concurrency, rules_config,
-                   rules_metrics, rules_rpc, rules_threads)
+                   rules_deadline, rules_jax, rules_metrics, rules_resources,
+                   rules_rpc, rules_threads)
 
     return [
         rules_async.check_rt001,
@@ -224,6 +242,9 @@ def all_rules():
         rules_concurrency.check_rt007,
         rules_concurrency.check_rt008,
         rules_config.check_rt009,
+        rules_jax.check_rt010,
+        rules_resources.check_rt011,
+        rules_deadline.check_rt012,
     ]
 
 
@@ -280,7 +301,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="ray_tpu lint",
-        description="framework-aware static analysis (rules RT001-RT009)",
+        description="framework-aware static analysis (rules RT001-RT012)",
     )
     ap.add_argument("--root", default=None,
                     help="package directory to lint (default: the "

@@ -1,0 +1,576 @@
+"""RT010: JAX hot-path compile/sync hazards.
+
+The reference stack catches these classes of bug with profiling after the
+fact (a recompile shows as a multi-second step-time spike, a stray host
+sync as a flat device-utilization valley).  rtlint finds them at lint
+time instead, on the same interprocedural substrate the concurrency
+rules use (:class:`~.astutil.ConcurrencyModel`):
+
+**Hot set.**  Seeded at jit boundaries — ``@jax.jit`` /
+``@partial(jax.jit, ...)`` defs and ``x = jax.jit(f)`` bindings — and
+grown along the call graph: a function is *hot* when it invokes a jitted
+program from inside a loop, or is itself invoked from a loop of a hot
+function (the engine's ``_loop`` -> ``_run_step`` -> ``_prefill`` chain,
+a learner's minibatch epochs).  Per-step code is exactly where a hidden
+recompile or sync multiplies by the step count.
+
+Findings:
+
+- **jit-in-loop** — a ``jax.jit(...)`` wrapping (or jit-decorated def)
+  lexically inside a loop: every iteration builds a fresh callable with
+  an empty cache, i.e. a guaranteed recompile per iteration.
+- **unhashable static arg** — a list/dict/set literal passed in a
+  ``static_argnums`` position: static args key the compile cache by
+  hash, so this raises (or, wrapped, retraces) on every call.
+- **host sync in the hot set** — ``.item()`` / ``float()`` / ``int()`` /
+  ``bool()`` / ``np.asarray()`` / ``jax.device_get()`` /
+  ``.block_until_ready()`` applied to a value reachable from a jitted
+  program's output inside a hot function.  Each one blocks the host on
+  device completion mid-step; sanctioned syncs (THE step's readback
+  point) carry a trailing ``# rt-sync-ok: <reason>``.
+- **donated arg read after call** — a ``donate_argnums`` argument is
+  dead the moment the call dispatches; a later read on the same path
+  sees an invalidated buffer.  Rebinding the name in the donating call's
+  own assignment (``self.pools = step(..., self.pools, ...)``) is the
+  sanctioned shape.
+
+``--json`` meta carries the hot-path derivation (``hot_via``) so a
+finding explains WHY that function is step-rate code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import (ConcurrencyModel, FuncInfo, call_name, dotted_name,
+                      parent_map, walk_own_body, _line_annotation)
+from .rtlint import Finding, Project
+
+_SYNC_OK_RE = re.compile(r"#\s*rt-sync-ok:\s*(.+?)\s*$")
+
+_JIT_NAMES = ("jax.jit", "jit")
+_PARTIAL_NAMES = ("partial", "functools.partial")
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: host-sync builtins taking the syncing value as first argument.
+_SYNC_CALLS = frozenset({"float", "int", "bool"})
+#: dotted callables that materialize device values on the host.
+_SYNC_DOTTED_TAILS = frozenset({"asarray", "array", "device_get"})
+_SYNC_DOTTED_RECV = frozenset({"np", "numpy", "jax", "onp"})
+#: method calls on a device value that force a sync.
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+def _full_model(project: Project) -> ConcurrencyModel:
+    """Whole-tree model (the concurrency rules scope to core/; the jit
+    hot paths live in models/, serve/, rllib/, train/)."""
+    cached = getattr(project, "_rt_full_model", None)
+    if cached is None:
+        cached = project._rt_full_model = ConcurrencyModel(
+            list(project.modules))
+    return cached
+
+
+def _int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    """Literal ints inside a static_argnums/donate_argnums value."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return tuple(out)
+    return ()
+
+
+class _JitInfo:
+    __slots__ = ("name", "module", "line", "static", "donate")
+
+    def __init__(self, name, module, line, static=(), donate=()):
+        self.name = name
+        self.module = module  # rel
+        self.line = line
+        self.static = static
+        self.donate = donate
+
+
+class _JitIndex:
+    """Where the jitted callables are: decorated defs (by bare name),
+    ``x = jax.jit(f)`` bindings (by scope), ``self.x = jax.jit(f)``
+    class attrs (by (module, class))."""
+
+    def __init__(self, project: Project):
+        self.defs: Dict[str, _JitInfo] = {}
+        self.scoped: Dict[Tuple[str, Optional[int]], Dict[str, _JitInfo]] = {}
+        self.class_attrs: Dict[Tuple[str, str], Dict[str, _JitInfo]] = {}
+        self.jit_calls: List[Tuple] = []  # (module, Call, parents)
+        for mod in project.modules:
+            parents = parent_map(mod.tree)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, _FUNC_NODES):
+                    info = self._decorated(node, mod)
+                    if info is not None:
+                        self.defs.setdefault(node.name, info)
+                elif isinstance(node, ast.Call) \
+                        and call_name(node) in _JIT_NAMES:
+                    self.jit_calls.append((mod, node, parents))
+                    self._bind(mod, node, parents)
+
+    def _decorated(self, node, mod) -> Optional[_JitInfo]:
+        for dec in node.decorator_list:
+            if dotted_name(dec) in _JIT_NAMES:
+                return _JitInfo(node.name, mod.rel, node.lineno)
+            if isinstance(dec, ast.Call):
+                callee = dotted_name(dec.func)
+                if callee in _JIT_NAMES:
+                    return _JitInfo(node.name, mod.rel, node.lineno,
+                                    *self._nums(dec))
+                if callee in _PARTIAL_NAMES and dec.args \
+                        and dotted_name(dec.args[0]) in _JIT_NAMES:
+                    return _JitInfo(node.name, mod.rel, node.lineno,
+                                    *self._nums(dec))
+        return None
+
+    @staticmethod
+    def _nums(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        static = donate = ()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                static = _int_tuple(kw.value)
+            elif kw.arg == "donate_argnums":
+                donate = _int_tuple(kw.value)
+        return static, donate
+
+    def _bind(self, mod, call: ast.Call, parents) -> None:
+        parent = parents.get(call)
+        if not isinstance(parent, ast.Assign) or len(parent.targets) != 1:
+            return
+        static, donate = self._nums(call)
+        t = parent.targets[0]
+        if isinstance(t, ast.Name):
+            # Scope key: the innermost enclosing function def (by lineno)
+            # or None for module scope.
+            fn = None
+            cur = parents.get(parent)
+            while cur is not None:
+                if isinstance(cur, _FUNC_NODES):
+                    fn = cur
+                    break
+                cur = parents.get(cur)
+            key = (mod.rel, fn.lineno if fn is not None else None)
+            self.scoped.setdefault(key, {})[t.id] = _JitInfo(
+                t.id, mod.rel, call.lineno, static, donate)
+        elif isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            cls = None
+            cur = parents.get(parent)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    cls = cur.name
+                    break
+                cur = parents.get(cur)
+            if cls is not None:
+                self.class_attrs.setdefault((mod.rel, cls), {})[t.attr] = \
+                    _JitInfo(t.attr, mod.rel, call.lineno, static, donate)
+
+    def resolve_call(self, call: ast.Call, func: FuncInfo
+                     ) -> Optional[_JitInfo]:
+        """Is this call site invoking a jitted callable?"""
+        f = call.func
+        if isinstance(f, ast.Name):
+            # Innermost scope first: function-local binding, then
+            # enclosing defs, then module scope, then jitted-def names.
+            cur = func
+            while cur is not None:
+                hit = self.scoped.get(
+                    (func.module.rel, cur.node.lineno), {}).get(f.id)
+                if hit is not None:
+                    return hit
+                cur = cur.parent
+            hit = self.scoped.get((func.module.rel, None), {}).get(f.id)
+            if hit is not None:
+                return hit
+            return self.defs.get(f.id)
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and func.cls is not None:
+                hit = self.class_attrs.get(
+                    (func.module.rel, func.cls), {}).get(f.attr)
+                if hit is not None:
+                    return hit
+            # paged.paged_decode_step(...) / models.adapter_load(...)
+            return self.defs.get(f.attr)
+        return None
+
+
+def _in_loop(node: ast.AST, func_node: ast.AST, parents) -> bool:
+    """Is ``node`` lexically inside a loop within this function?"""
+    cur = parents.get(node)
+    while cur is not None and cur is not func_node:
+        if isinstance(cur, _LOOPS):
+            return True
+        if isinstance(cur, _FUNC_NODES):
+            return False
+        cur = parents.get(cur)
+    return False
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _self_attrs_in(node: ast.AST) -> Set[str]:
+    return {n.attr for n in ast.walk(node)
+            if isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name) and n.value.id == "self"}
+
+
+def _taint_targets(target: ast.AST, names: Set[str], attrs: Set[str]):
+    if isinstance(target, ast.Name):
+        names.add(target.id)
+    elif isinstance(target, ast.Attribute) \
+            and isinstance(target.value, ast.Name) \
+            and target.value.id == "self":
+        attrs.add(target.attr)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            _taint_targets(el, names, attrs)
+
+
+def _refs_taint(node: ast.AST, names: Set[str], attrs: Set[str]) -> bool:
+    if _names_in(node) & names:
+        return True
+    return bool(_self_attrs_in(node) & attrs)
+
+
+def _derives_taint(node: ast.AST, names: Set[str], attrs: Set[str],
+                   jit_calls: Set[int]) -> bool:
+    """Does evaluating ``node`` yield device data?  A jit call does; so
+    does any pure access path over tainted values (name, subscript,
+    attribute, method call ON a tainted receiver like ``aux.items()``).
+    A call to anything ELSE launders the taint: a host function's return
+    is host data (``env.step(acts)`` does not make rewards device
+    arrays)."""
+    has_jit = any(id(c) in jit_calls for c in ast.walk(node)
+                  if isinstance(c, ast.Call))
+    if has_jit:
+        return True
+    if not _refs_taint(node, names, attrs):
+        return False
+    for call in ast.walk(node):
+        if not isinstance(call, ast.Call):
+            continue
+        f = call.func
+        if isinstance(f, ast.Attribute) \
+                and _refs_taint(f.value, names, attrs):
+            continue  # method on a tainted receiver keeps the taint
+        return False
+    return True
+
+
+class _HotFunc:
+    __slots__ = ("func", "via", "jit_sites", "whole_body_hot")
+
+    def __init__(self, func, via, whole_body_hot):
+        self.func = func
+        self.via = via  # human-readable derivation
+        self.jit_sites: List[Tuple[ast.Call, _JitInfo]] = []
+        # True when the WHOLE function runs per step (it is invoked from
+        # a loop).  False when it merely CONTAINS the step loop: its
+        # post-loop epilogue runs once, and a sync there is the
+        # sanctioned readback point, not a per-step stall.
+        self.whole_body_hot = whole_body_hot
+
+
+def _hot_set(model: ConcurrencyModel, index: _JitIndex
+             ) -> Dict[FuncInfo, _HotFunc]:
+    # Per-function: jitted call sites + whether each is inside a loop.
+    jit_sites: Dict[FuncInfo, List[Tuple[ast.Call, _JitInfo, bool]]] = {}
+    in_loop_edges: Dict[FuncInfo, List[Tuple[FuncInfo, int]]] = {}
+    pmaps: Dict[str, dict] = {}
+    for func in model.functions:
+        pmap = pmaps.setdefault(func.module.rel, parent_map(func.module.tree))
+        for node in walk_own_body(func.node):
+            if not isinstance(node, ast.Call):
+                continue
+            loop = _in_loop(node, func.node, pmap)
+            ji = index.resolve_call(node, func)
+            if ji is not None:
+                jit_sites.setdefault(func, []).append((node, ji, loop))
+            if loop:
+                callee = model._resolve_callable(node.func, func)
+                if callee is not None:
+                    in_loop_edges.setdefault(callee, []).append(
+                        (func, node.lineno))
+    hot: Dict[FuncInfo, _HotFunc] = {}
+
+    def mark(func, via, whole_body):
+        hf = hot.get(func)
+        if hf is not None:
+            hf.whole_body_hot = hf.whole_body_hot or whole_body
+            return
+        hf = _HotFunc(func, via, whole_body)
+        hf.jit_sites = [(c, j) for c, j, _ in jit_sites.get(func, [])]
+        hot[func] = hf
+
+    # Seed A: loops directly driving a jitted program.
+    for func, sites in jit_sites.items():
+        for call, ji, loop in sites:
+            if loop:
+                mark(func, f"calls jitted {ji.name!r} in a loop "
+                           f"(line {call.lineno})", whole_body=False)
+                break
+    # Seed B: jit-calling functions themselves driven from a loop.
+    for func, sites in jit_sites.items():
+        if func not in in_loop_edges:
+            continue
+        caller, line = in_loop_edges[func][0]
+        mark(func, f"calls jitted {sites[0][1].name!r}; invoked from a "
+                   f"loop in {caller.qualname} (line {line})",
+             whole_body=True)
+    # One propagation round: functions a hot function drives from ITS
+    # loops (the engine's _run_step -> _prefill), and jit-calling
+    # functions a hot function calls at all (per-step helpers).
+    for func in list(hot):
+        for callee, edges in in_loop_edges.items():
+            if callee in hot:
+                continue
+            for caller, line in edges:
+                if caller in hot:
+                    mark(callee, f"invoked from a loop in hot "
+                                 f"{caller.qualname} (line {line})",
+                         whole_body=True)
+                    break
+    for cs in model.call_sites:
+        if cs.func in hot and cs.callee not in hot \
+                and cs.callee in jit_sites:
+            mark(cs.callee,
+                 f"calls a jitted program; called from hot "
+                 f"{cs.func.qualname} (line {cs.line})", whole_body=True)
+    return hot
+
+
+def _function_taint(hf: _HotFunc) -> Tuple[Set[str], Set[str]]:
+    """Names/self-attrs holding (or derived from) jitted-program outputs
+    inside one hot function."""
+    func = hf.func
+    jit_calls = {id(c) for c, _ in hf.jit_sites}
+    names: Set[str] = set()
+    attrs: Set[str] = set()
+    stmts = [n for n in walk_own_body(func.node)]
+    for _ in range(3):  # seed + two derivation rounds
+        before = (len(names), len(attrs))
+        for node in stmts:
+            if isinstance(node, ast.Assign):
+                if _derives_taint(node.value, names, attrs, jit_calls):
+                    for t in node.targets:
+                        _taint_targets(t, names, attrs)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if _derives_taint(node.iter, names, attrs, jit_calls):
+                    _taint_targets(node.target, names, attrs)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    if _derives_taint(gen.iter, names, attrs, jit_calls):
+                        _taint_targets(gen.target, names, attrs)
+        if (len(names), len(attrs)) == before:
+            break
+    return names, attrs
+
+
+def _sync_kind(call: ast.Call, names: Set[str], attrs: Set[str]
+               ) -> Optional[str]:
+    """The sync shape of a call on tainted data, or None."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in _SYNC_CALLS and call.args:
+        if _refs_taint(call.args[0], names, attrs):
+            return f"{f.id}() on a device value"
+        return None
+    if isinstance(f, ast.Attribute):
+        if f.attr in _SYNC_METHODS \
+                and _refs_taint(f.value, names, attrs):
+            return f".{f.attr}() on a device value"
+        if f.attr in _SYNC_DOTTED_TAILS and call.args:
+            recv = f.value
+            if isinstance(recv, ast.Name) and recv.id in _SYNC_DOTTED_RECV \
+                    and _refs_taint(call.args[0], names, attrs):
+                return f"{recv.id}.{f.attr}() on a device value"
+    return None
+
+
+def check_rt010(project: Project) -> List[Finding]:
+    model = _full_model(project)
+    index = _JitIndex(project)
+    out: List[Finding] = []
+
+    # -- jit-in-loop + unhashable static args (whole tree) --------------------
+    for mod, call, parents in index.jit_calls:
+        if _in_loop(call, mod.tree, parents):
+            out.append(Finding(
+                "RT010", mod.rel, call.lineno,
+                "jax.jit(...) inside a loop: each iteration builds a "
+                "fresh callable with an empty compile cache (a recompile "
+                "per iteration) — hoist the jitted callable out of the "
+                "loop",
+                meta={"kind": "jit_in_loop"}))
+    for mod in project.modules:
+        pmap = parent_map(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, _FUNC_NODES):
+                info = index._decorated(node, mod)
+                if info is not None and _in_loop(node, mod.tree, pmap):
+                    out.append(Finding(
+                        "RT010", mod.rel, node.lineno,
+                        f"jitted def {node.name!r} defined inside a loop: "
+                        "every iteration re-wraps it with an empty "
+                        "compile cache — define it once outside the loop",
+                        meta={"kind": "jit_in_loop"}))
+
+    hot = _hot_set(model, index)
+    for hf in hot.values():
+        func = hf.func
+        mod = func.module
+        names, attrs = _function_taint(hf)
+        for call, ji in hf.jit_sites:
+            # Unhashable static args: compile-cache keys must hash.
+            for idx in ji.static:
+                if idx < len(call.args) and isinstance(
+                        call.args[idx], (ast.List, ast.Dict, ast.Set)):
+                    out.append(Finding(
+                        "RT010", mod.rel, call.lineno,
+                        f"unhashable literal in static_argnums position "
+                        f"{idx} of jitted {ji.name!r}: static args key "
+                        "the compile cache by hash — pass a tuple or a "
+                        "hashable config object",
+                        meta={"kind": "unhashable_static",
+                              "program": ji.name, "argnum": idx,
+                              "hot_via": hf.via}))
+            out.extend(_check_donation(func, call, ji, hf))
+        if not names and not attrs:
+            continue
+        pmap = parent_map(func.node)
+        for node in walk_own_body(func.node):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _sync_kind(node, names, attrs)
+            if kind is None:
+                continue
+            if not hf.whole_body_hot \
+                    and not _in_loop(node, func.node, pmap):
+                # The function CONTAINS the step loop; its epilogue runs
+                # once — a post-loop readback is the sanctioned shape.
+                continue
+            if _line_annotation(mod, node.lineno, _SYNC_OK_RE):
+                continue
+            out.append(Finding(
+                "RT010", mod.rel, node.lineno,
+                f"implicit host sync in the jit hot set: {kind} inside "
+                f"{func.qualname} ({hf.via}) blocks the host on device "
+                "completion every step — hoist the readback out of the "
+                "hot path or vet THE step's readback point with "
+                "# rt-sync-ok: <reason>",
+                meta={"kind": "host_sync", "sync": kind,
+                      "hot_via": hf.via}))
+    return _dedup(out)
+
+
+def _check_donation(func: FuncInfo, call: ast.Call, ji: _JitInfo,
+                    hf: _HotFunc) -> List[Finding]:
+    """A donated buffer is dead after the call: flag loads of the donated
+    name in subsequent statements of the same block, unless the donating
+    call's own assignment (or a later one) rebinds it first."""
+    if not ji.donate:
+        return []
+    donated: List[Tuple[str, Optional[str]]] = []  # (name, self_attr)
+    for idx in ji.donate:
+        if idx >= len(call.args):
+            continue
+        arg = call.args[idx]
+        if isinstance(arg, ast.Name):
+            donated.append((arg.id, None))
+        elif isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id == "self":
+            donated.append((arg.attr, "self"))
+    if not donated:
+        return []
+    pmap = parent_map(func.node)
+    # The statement containing the call, and its containing block.
+    stmt = call
+    while stmt in pmap and not isinstance(stmt, ast.stmt):
+        stmt = pmap[stmt]
+    block = pmap.get(stmt)
+    if block is None:
+        return []
+    for field in ("body", "orelse", "finalbody"):
+        stmts = getattr(block, field, None)
+        if stmts and stmt in stmts:
+            break
+    else:
+        return []
+    # Names the donating statement itself rebinds.
+    rebound: Set[Tuple[str, Optional[str]]] = set()
+    if isinstance(stmt, ast.Assign):
+        rn: Set[str] = set()
+        ra: Set[str] = set()
+        for t in stmt.targets:
+            _taint_targets(t, rn, ra)
+        rebound |= {(n, None) for n in rn} | {(a, "self") for a in ra}
+    out: List[Finding] = []
+    live = [d for d in donated if d not in rebound]
+    for later in stmts[stmts.index(stmt) + 1:]:
+        if not live:
+            break
+        # Loads are checked BEFORE this statement's rebinds take effect:
+        # in ``buf = buf + 0`` the RHS still reads the dead buffer.
+        for name, recv in list(live):
+            for node in ast.walk(later):
+                if recv is None and isinstance(node, ast.Name) \
+                        and node.id == name \
+                        and isinstance(node.ctx, ast.Load):
+                    hit = node
+                elif recv == "self" and isinstance(node, ast.Attribute) \
+                        and node.attr == name \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and isinstance(node.ctx, ast.Load):
+                    hit = node
+                else:
+                    continue
+                label = f"self.{name}" if recv else name
+                out.append(Finding(
+                    "RT010", func.module.rel, hit.lineno,
+                    f"donated argument {label!r} read after the donating "
+                    f"call to jitted {ji.name!r} (line {call.lineno}): "
+                    "donate_argnums invalidates the buffer at dispatch — "
+                    "rebind the name from the call's result before any "
+                    "further use",
+                    meta={"kind": "donation_use_after", "program": ji.name,
+                          "donated": label, "call_line": call.lineno,
+                          "hot_via": hf.via}))
+                live = [d for d in live if d != (name, recv)]
+                break
+        for node in ast.walk(later):
+            if isinstance(node, ast.Assign):
+                rn, ra = set(), set()
+                for t in node.targets:
+                    _taint_targets(t, rn, ra)
+                live = [d for d in live
+                        if d not in {(n, None) for n in rn}
+                        and d not in {(a, "self") for a in ra}]
+    return out
+
+
+def _dedup(findings: List[Finding]) -> List[Finding]:
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line))

@@ -37,25 +37,34 @@ from .llama import LlamaConfig, _mlp
 Params = Any
 PagedPools = Dict[str, jax.Array]  # {"k": [L, P+1, H_kv, page, D], "v": ...}
 
-#: jit-trace counters per program name; a bump means XLA compiled a new
-#: specialization (python bodies only run while tracing).
-_TRACE_COUNTS: Dict[str, int] = {}
+# jit-trace counters per program name; a bump means XLA compiled a new
+# specialization (python bodies only run while tracing).  The counters
+# live in the devtools.jitguard registry (shared with the rllib learner
+# updates and armed as a recompile sentinel under RT_DEBUG_JIT=1); the
+# names below are kept as aliases so devmem snapshots and the engine's
+# ``decode_traces`` assertions read unchanged.
+from ..devtools import jitguard as _jitguard
+
+PAGED_PROGRAMS = ("decode", "prefill", "prefill_prefix", "page_copy",
+                  "adapter_load")
+for _prog in PAGED_PROGRAMS:
+    _jitguard.register_program(_prog)
 
 
 def trace_count(name: str) -> int:
     """Times the named program (``"decode"`` / ``"prefill"``) was traced."""
-    return _TRACE_COUNTS.get(name, 0)
+    return _jitguard.count(name)
 
 
 def trace_counts() -> Dict[str, int]:
     """Snapshot of every program's trace count (devmem/compile
     observability: a nonzero delta between snapshots means XLA compiled
     a new specialization in that window)."""
-    return dict(_TRACE_COUNTS)
+    return _jitguard.counts()
 
 
-def _bump(name: str) -> None:
-    _TRACE_COUNTS[name] = _TRACE_COUNTS.get(name, 0) + 1
+def _bump(name: str, **arrays: Any) -> None:
+    _jitguard.bump(name, _jitguard.signature_of(arrays) if arrays else None)
 
 
 def init_paged_pools(config: LlamaConfig, num_pages: int,
@@ -112,7 +121,7 @@ def adapter_load(adapters: AdapterArrays, slot: jax.Array,
                  packed: AdapterArrays) -> AdapterArrays:
     """Overwrite one pool slot in place (slot index is data; pool arrays
     are donated so load/evict churn never copies the resident set)."""
-    _bump("adapter_load")
+    _bump("adapter_load", slot=slot, qa=packed["qa"], scale=packed["scale"])
     return {name: adapters[name].at[slot].set(packed[name])
             for name in ("qa", "qb", "va", "vb", "scale")}
 
@@ -248,7 +257,8 @@ def paged_decode_step(config: LlamaConfig, params: Params,
     traffic is downloading the [B] sampled tokens — host-side key
     folding measurably dominates step time otherwise.  Returns
     (next_tokens [B], new_seq_lens [B], new_key, pools)."""
-    _bump("decode")
+    _bump("decode", tokens=tokens, page_tables=page_tables,
+          seq_lens=seq_lens, temps=temps, adapter_ids=adapter_ids, key=key)
     B = tokens.shape[0]
     maxp = page_tables.shape[1]
     ps = pools["k"].shape[3]
@@ -324,7 +334,8 @@ def paged_prefill(config: LlamaConfig, params: Params, pools: PagedPools,
     length until decode overwrites it) or to the scratch page past the
     allocated prefix.  The key advances on device like the decode step's.
     Returns (first_token scalar, new_key, pools)."""
-    _bump("prefill")
+    _bump("prefill", tokens=tokens, page_table=page_table, temp=temp,
+          key=key)
     _, s_pad = tokens.shape
     ps = pools["k"].shape[3]
     n_rep = config.n_heads // config.n_kv_heads
@@ -399,7 +410,8 @@ def paged_prefill_prefix(config: LlamaConfig, params: Params,
     Queries then attend the full gathered table like the decode step —
     cached prefix plus fresh suffix — masked by global causal position.
     Returns (first_token scalar, new_key, pools)."""
-    _bump("prefill_prefix")
+    _bump("prefill_prefix", tokens=tokens, page_table=page_table,
+          temp=temp, key=key)
     _, s_pad = tokens.shape
     maxp = page_table.shape[0]
     ps = pools["k"].shape[3]
@@ -468,7 +480,7 @@ def copy_page(pools: PagedPools, src: jax.Array,
     """Copy one page's K/V across every layer (copy-on-write when a
     request diverges mid-page from a cached prefix).  src/dst are data —
     one compile covers every divergence."""
-    _bump("page_copy")
+    _bump("page_copy", src=src, dst=dst)
     k, v = pools["k"], pools["v"]
     return {"k": k.at[:, dst].set(k[:, src]),
             "v": v.at[:, dst].set(v[:, src])}

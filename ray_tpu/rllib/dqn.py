@@ -141,7 +141,13 @@ class DQNLearner:
             return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
                           "qf_mean": jnp.mean(q_sa)}
 
+        from ..devtools import jitguard
+
+        jitguard.register_program("dqn_update")
+
         def update(params, target_params, opt_state, batch):
+            # Trace-time only: joins the recompile sentinel (RT_DEBUG_JIT).
+            jitguard.bump("dqn_update", jitguard.signature_of(batch))
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, target_params, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -171,12 +177,21 @@ class DQNLearner:
 
         return list(jax.tree.map(np.asarray, self.params))
 
-    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def update_raw(self, batch: Dict[str, np.ndarray]):
+        """One TD update, aux left ON DEVICE: the K-updates-per-iteration
+        loop in :meth:`DQN.train` calls this so the host never blocks on
+        loss readback mid-loop (rtlint RT010) — only the loop's last aux
+        is converted, once, by the caller."""
         import jax.numpy as jnp
 
         mb = {k: jnp.asarray(v) for k, v in batch.items()}
         self.params, self.opt_state, aux = self._update(
             self.params, self.target_params, self.opt_state, mb)
+        return aux
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        aux = self.update_raw(batch)
+        # THE readback point for one-off callers (single update -> floats).
         return {k: float(v) for k, v in aux.items()}
 
     def sync_target(self):
@@ -237,13 +252,19 @@ class DQN:
 
         metrics: Dict[str, float] = {}
         if self.total_env_steps >= cfg.learning_starts:
+            last_aux = None
             for _ in range(cfg.num_updates_per_iteration):
-                metrics = self.learner.update_from_batch(
+                last_aux = self.learner.update_raw(
                     self.buffer.sample(cfg.train_batch_size))
                 self.total_updates += 1
                 if self.total_updates % cfg.target_update_freq == 0:
                     self.learner.sync_target()
             self._sync_weights()
+            if last_aux is not None:
+                # ONE host sync after the K TD updates (rtlint RT010):
+                # the devices pipeline the whole update burst instead of
+                # stalling on each loss readback.
+                metrics = {k: float(v) for k, v in last_aux.items()}
 
         self.iteration += 1
         wall = time.perf_counter() - t0

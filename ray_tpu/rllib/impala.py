@@ -215,7 +215,13 @@ class ImpalaLearner:
                 "mean_rho": jnp.mean(jnp.minimum(ratio, rho_bar)),
             }
 
+        from ..devtools import jitguard
+
+        jitguard.register_program("impala_update")
+
         def update(params, opt_state, batch):
+            # Trace-time only: joins the recompile sentinel (RT_DEBUG_JIT).
+            jitguard.bump("impala_update", jitguard.signature_of(batch))
             (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch
             )

@@ -173,7 +173,15 @@ class PPOLearner:
             return total, {"policy_loss": pg, "vf_loss": vf,
                            "entropy": entropy}
 
+        from ..devtools import jitguard
+
+        jitguard.register_program("ppo_update")
+
         def update(params, opt_state, batch):
+            # Trace-time only: joins the recompile sentinel (RT_DEBUG_JIT)
+            # so a post-warmup shape/dtype drift in the minibatch raises
+            # at the stray trace instead of silently recompiling.
+            jitguard.bump("ppo_update", jitguard.signature_of(batch))
             (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch
             )
@@ -223,7 +231,7 @@ class PPOLearner:
         batch = dict(batch)
         batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
         rng = np.random.default_rng(seed)
-        metrics: Dict[str, float] = {}
+        last_aux = None
         for _ in range(num_epochs):
             order = rng.permutation(n)
             for start in range(0, n, minibatch_size):
@@ -234,5 +242,11 @@ class PPOLearner:
                 self.params, self.opt_state, aux = self._update(
                     self.params, self.opt_state, mb
                 )
-                metrics = {k: float(v) for k, v in aux.items()}
-        return metrics
+                last_aux = aux
+        # ONE host sync, after the epochs: float()-ing aux inside the
+        # minibatch loop blocked on device work every step (rtlint RT010)
+        # — SGD should only wait for the device when the metrics are
+        # actually read.
+        if last_aux is None:
+            return {}
+        return {k: float(v) for k, v in last_aux.items()}

@@ -193,7 +193,13 @@ class SACLearner:
             return _mlp_apply(qp, jnp.concatenate(
                 [obs, (act - bias) / scale], -1))[..., 0]
 
+        from ..devtools import jitguard
+
+        jitguard.register_program("sac_update")
+
         def update(params: SACParams, opt_state, batch, key):
+            # Trace-time only: joins the recompile sentinel (RT_DEBUG_JIT).
+            jitguard.bump("sac_update", jitguard.signature_of(batch))
             k1, k2 = jax.random.split(key)
             alpha = jnp.exp(params.log_alpha)
 

@@ -395,7 +395,7 @@ def cmd_down(args) -> int:
 
 def cmd_lint(args) -> int:
     """rtlint: framework-aware static analysis over the ray_tpu package
-    (rules RT001-RT009; see ray_tpu/devtools/rtlint.py).  Needs no
+    (rules RT001-RT012; see ray_tpu/devtools/rtlint.py).  Needs no
     running cluster."""
     from .devtools import rtlint
 
@@ -1046,7 +1046,7 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser(
-        "lint", help="framework-aware static analysis (RT001-RT009)"
+        "lint", help="framework-aware static analysis (RT001-RT012)"
     )
     p.add_argument("--json", action="store_true",
                    help="machine-readable findings")

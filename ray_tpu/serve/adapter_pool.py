@@ -37,8 +37,13 @@ class AdapterPool:
 
     def __init__(self, model_config, max_adapters: int = 4,
                  rank: int = 8):
+        from ..devtools import jitguard
         from ..models.paged import init_adapter_pool
 
+        # A fresh pool may carry a new rank/slot-count shape: stand the
+        # adapter_load program's armed baseline down (recompile sentinel)
+        # so its cold trace isn't mistaken for a hot-path recompile.
+        jitguard.register_program("adapter_load")
         self.model_config = model_config
         self.max_adapters = max_adapters
         self.rank = rank
@@ -154,6 +159,22 @@ class AdapterPool:
         self._pins.clear()
         self._lru.clear()
         self._pending.clear()
+
+    def warmup_compile(self) -> None:
+        """Trace the ``adapter_load`` program before the recompile
+        sentinel arms (engine ``warmup()``): a zero payload written into
+        the permanent zero slot is a value no-op, but it compiles the
+        load path so the first REAL adapter load after warmup is an
+        execution, not a post-warmup trace.  Loop thread only (device
+        work, donates the arrays like any load)."""
+        import jax.numpy as jnp
+
+        from ..models.paged import adapter_load
+
+        packed = {name: jnp.zeros_like(arr[0])
+                  for name, arr in self.arrays.items()}
+        self.arrays = adapter_load(
+            self.arrays, jnp.asarray(self.zero_slot, jnp.int32), packed)
 
     # -------------------------------------------------------------- internal
 

@@ -218,9 +218,17 @@ class InferenceEngine:
                  seed: int = 0):
         import jax
 
-        from ..models.paged import (PageAllocator, init_paged_pools)
+        from ..devtools import jitguard
+        from ..models.paged import (PAGED_PROGRAMS, PageAllocator,
+                                    init_paged_pools)
         from ..util.metrics import get_counter, get_gauge, get_histogram
 
+        # A fresh engine means fresh geometry: re-registering stands the
+        # paged programs' armed baselines down (recompile sentinel) until
+        # this engine's own warmup() re-arms — an un-warmed engine's cold
+        # traces are a compile phase, not hot-path recompiles.
+        for prog in PAGED_PROGRAMS:
+            jitguard.register_program(prog)
         self.model_config = model_config
         self.params = params
         self.config = config
@@ -620,6 +628,11 @@ class InferenceEngine:
         """Compile the decode program and every prefill bucket up front
         (one dummy sequence per bucket) so serving traffic never pays a
         trace."""
+        # A fresh engine's warmup is a legitimate compile phase: stand
+        # the sentinel down while it traces (a previous engine in this
+        # process may have armed with different geometry), re-arm below.
+        from ..devtools import jitguard
+        jitguard.disarm()
         # max_new_tokens=2: the first token comes from PREFILL — the
         # decode program only compiles once a second token is needed.
         probe = self.submit([1], max_new_tokens=2)
@@ -627,6 +640,11 @@ class InferenceEngine:
             pass
         for bucket in self.config.prefill_buckets()[1:]:
             n = min(bucket, self.config.max_prompt_len)
+            if self._cache is not None:
+                # The previous bucket's ones-prompt cached its pages; a
+                # hit here would route to the suffix path and skip the
+                # cold prefill compile this bucket exists to pay.
+                self.clear_prefix_cache()
             s = self.submit(np.ones((n,), np.int32), max_new_tokens=1)
             for _ in s:
                 pass
@@ -639,6 +657,37 @@ class InferenceEngine:
                                  max_new_tokens=1):
                 pass
             self.clear_prefix_cache()
+            # The re-run traces the prefix path only for the ONE suffix
+            # bucket (and COW divergence) its geometry happens to hit —
+            # compile every suffix bucket and the COW copy explicitly
+            # (dummy tokens into the scratch page; page 0 onto itself)
+            # so no real prefix hit after warmup pays a trace.
+            def _warm_prefix_path():
+                import jax.numpy as jnp
+
+                from ..models.paged import copy_page, paged_prefill_prefix
+                adapters = self.adapter_pool.arrays
+                pt = jnp.full((self.maxp,), self.scratch, jnp.int32)
+                zero = jnp.asarray(0, jnp.int32)
+                temp = jnp.asarray(0.0, jnp.float32)
+                for b in self.config.prefill_buckets():
+                    _, self._d_key, self.pools = paged_prefill_prefix(
+                        self.model_config, self.params, self.pools,
+                        adapters, jnp.zeros((1, b), jnp.int32), zero,
+                        jnp.asarray(1, jnp.int32), pt, zero, temp,
+                        self._d_key)
+                self.pools = copy_page(self.pools, zero, zero)
+            self._run_on_loop(_warm_prefix_path)
+        # Compile the adapter-load path too (zero payload into the zero
+        # slot): the first real LoRA registration after warmup must be an
+        # execution, not a fresh trace.
+        self._run_on_loop(self.adapter_pool.warmup_compile)
+        # Recompile sentinel (RT_DEBUG_JIT=1): freeze every program's
+        # trace count — decode, each prefill bucket, the COW/suffix path,
+        # adapter loads — so any post-warmup trace raises RecompileError
+        # at the stray call site instead of silently paying a compile in
+        # the step loop.  No-op when the env flag is off.
+        jitguard.arm()
 
     # ---------------------------------------------------------------- loop
 
@@ -689,7 +738,7 @@ class InferenceEngine:
                 shared = match.pages
                 # Pin the match BEFORE any cache eviction below can free
                 # the very pages it names.
-                self._cache.claim(match, self.allocator)
+                self._cache.claim(match, self.allocator)  # rt-owns: prefix_claim
             need = need_total - len(shared)
             pages = self.allocator.alloc(need)
             if pages is None and self._cache is not None:
@@ -852,7 +901,7 @@ class InferenceEngine:
                 jnp.asarray(req.page_table), aid,
                 jnp.asarray(req.temperature, jnp.float32), self._d_key)
             self._m_prefill.inc(n)
-        first = int(first)
+        first = int(first)  # rt-sync-ok: THE prefill readback — the first token must reach the host to stream it
         # Cache every fully-frozen prompt page (decode appends past the
         # prompt, so pages wholly inside it never change again).
         if self._cache is not None:
@@ -1050,7 +1099,7 @@ class InferenceEngine:
             self._d_tokens, self._d_page_tables, self._d_seq_lens,
             self._d_active, self._d_temps, self._d_adapter_slots,
             self._d_key)
-        toks = np.asarray(self._d_tokens)
+        toks = np.asarray(self._d_tokens)  # rt-sync-ok: THE decode-step readback — one batched token fetch per step
         now = time.perf_counter()
         for slot, req in enumerate(self.slots):
             if req is None:

@@ -27,6 +27,16 @@ import ray_tpu
 REPLICA_RETRY_BUDGET = 3
 
 
+def _replica_retry_policy():
+    """Re-route pacing after a replica death: the unified jittered-doubling
+    curve (core/deadline.py), starting where the old hand-rolled ramp did
+    (200 ms) and capped at 1 s — a rolling update replaces a replica well
+    within the budget, so longer waits only add tail latency."""
+    from ..core.deadline import BackoffPolicy
+
+    return BackoffPolicy(base_s=0.2, multiplier=2.0, cap_s=1.0)
+
+
 def _count_replica_retry(path: str) -> None:
     from ..util.metrics import get_counter
 
@@ -62,22 +72,24 @@ class DeploymentResponse:
         from ..exceptions import (ActorDiedError, GetTimeoutError,
                                   WorkerCrashedError)
 
-        deadline = time.monotonic() + timeout
+        from ..core.deadline import Deadline
+
+        deadline = Deadline.after(timeout)
+        backoff = _replica_retry_policy()
         try:
             for attempt in range(REPLICA_RETRY_BUDGET):
                 last = attempt == REPLICA_RETRY_BUDGET - 1
                 get_timeout = timeout
                 if self._stall_timeout_s is not None:
-                    remaining = deadline - time.monotonic()
                     get_timeout = min(self._stall_timeout_s,
-                                      max(0.0, remaining))
+                                      max(0.0, deadline.remaining()))
                 try:
                     return ray_tpu.get(self._ref, timeout=get_timeout)
                 except (ActorDiedError, WorkerCrashedError):
                     if self._retry is None or last:
                         raise
                     _count_replica_retry("unary")
-                    time.sleep(0.2 * (attempt + 1))
+                    backoff.sleep(attempt + 1, deadline)
                     self._ref = self._retry()
                 except GetTimeoutError:
                     # Stalled replica (accepts, never answers): eject it
@@ -86,7 +98,7 @@ class DeploymentResponse:
                     # or the overall deadline is spent anyway.
                     if (self._stall_timeout_s is None or self._retry is None
                             or last
-                            or deadline - time.monotonic()
+                            or deadline.remaining()
                             <= self._stall_timeout_s):
                         raise
                     if self._eject is not None:
@@ -140,6 +152,7 @@ class DeploymentResponseGenerator:
         try:
             yielded = False
             attempt = 0
+            backoff = _replica_retry_policy()
             while True:
                 try:
                     for ref in self._gen:
@@ -152,7 +165,7 @@ class DeploymentResponseGenerator:
                             or attempt >= REPLICA_RETRY_BUDGET):
                         raise
                     _count_replica_retry("streaming")
-                    time.sleep(0.2 * attempt)
+                    backoff.sleep(attempt)
                     self._gen = self._retry()
         finally:
             self._release()

@@ -124,9 +124,12 @@ def snapshot() -> Dict[str, Any]:
         "compiles": compile_stats(),
     }
     try:
-        from ..models import paged as _paged
+        # The jitguard registry is the superset view: the paged programs
+        # plus any learner/kernel that joined (models.paged.trace_counts
+        # is an alias over the same counters).
+        from ..devtools import jitguard as _jitguard
 
-        snap["trace_counts"] = _paged.trace_counts()
+        snap["trace_counts"] = _jitguard.counts()
     except Exception:
         snap["trace_counts"] = {}
     _set_gauges(pools)

@@ -81,9 +81,14 @@ fails=0
 for i in $(seq 1 "$N"); do
     echo "=== chaos soak iteration $i/$N (mode=$MODE seed=$i) ==="
     LOG="$(mktemp /tmp/chaos_soak.XXXXXX.log)"
+    # RT_DEBUG_JIT=1: every engine/learner warmup arms the recompile
+    # sentinel, so a chaos path that perturbs a jitted program's shapes
+    # fails the iteration with the arg delta instead of silently
+    # paying a compile per step (devtools.jitguard / rtlint RT010).
     if ! env JAX_PLATFORMS=cpu RT_CHAOS_SEED="$i" \
         RT_NETFAULT_SEED="$i" \
         RT_DEBUG_LOCKS="$LOCKS_LEVEL" \
+        RT_DEBUG_JIT=1 \
         timeout -k 10 600 python -m pytest -q \
         -m "$MARK" $TARGETS \
         -p no:cacheprovider -p no:randomly \

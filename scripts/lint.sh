@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # rtlint gate: framework-aware static analysis over the ray_tpu package
-# (rules RT001-RT009, including the RT007/RT008 concurrency analysis and
+# (rules RT001-RT012, including the RT007/RT008 concurrency analysis and
 # RT009 spawn-env contract; engine in ray_tpu/devtools/rtlint.py, vetted
 # exceptions in .rtlint-allowlist).  Non-zero exit on any unallowlisted
 # finding — scripts/verify.sh runs this before pytest so drift never

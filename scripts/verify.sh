@@ -12,7 +12,7 @@ LOG="${T1_LOG:-/tmp/_t1.log}"
 TIMEOUT="${T1_TIMEOUT:-870}"
 rm -f "$LOG"
 
-# Static analysis first: rtlint (RT001-RT009) is cheap (~2s) and a drift
+# Static analysis first: rtlint (RT001-RT012) is cheap (~2s) and a drift
 # finding fails faster and more precisely than the test breakage it
 # foreshadows.  scripts/lint.sh exits non-zero on unallowlisted findings.
 if ! scripts/lint.sh; then
